@@ -1,0 +1,21 @@
+"""DeepConsensus-TPU: a TPU-native framework for polishing PacBio CCS reads.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of
+google/deepconsensus (reference: /root/reference): it turns subreads
+aligned to a draft circular-consensus sequence (CCS) into higher-quality
+consensus reads using a gap-aware encoder-only transformer.
+
+Subpackages:
+  constants     -- vocabulary, cigar ops, dataset split regions
+  utils         -- phred/sequence helpers (numpy + jax variants)
+  io            -- BAM/FASTQ/TFRecord I/O with zero external deps
+  preprocess    -- alignment-domain core: spacing, windowing, features
+  models        -- flax transformer, losses/metrics, training loops
+  ops           -- TPU kernels (banded attention, wavefront DP)
+  parallel      -- device meshes, shardings, ring attention
+  inference     -- batched inference runner
+  postprocess   -- window stitching
+  calibration   -- base-quality calibration + read filtering
+"""
+
+__version__ = '0.1.0'

@@ -1,0 +1,6 @@
+from deepconsensus_tpu.io.example_proto import Example  # noqa: F401
+from deepconsensus_tpu.io.tfrecord import (  # noqa: F401
+    TFRecordReader,
+    TFRecordWriter,
+    read_tfrecords,
+)

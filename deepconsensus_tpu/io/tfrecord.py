@@ -1,0 +1,134 @@
+"""Pure-Python TFRecord reader/writer with optional gzip compression.
+
+TFRecord framing per record: little-endian uint64 length, masked crc32c of
+the length bytes, payload, masked crc32c of the payload. The reference
+pipeline writes gzip-compressed TFRecord shards
+(reference: deepconsensus/preprocess/preprocess.py:183-196,
+models/data_providers.py:346).
+"""
+from __future__ import annotations
+
+import glob as globlib
+import gzip
+import struct
+from typing import Iterable, Iterator, List, Optional, Union
+
+# ---------------------------------------------------------------------------
+# crc32c (Castagnoli), table-driven.
+# ---------------------------------------------------------------------------
+_CRC_TABLE = []
+
+
+def _build_table() -> None:
+  poly = 0x82F63B78
+  for i in range(256):
+    crc = i
+    for _ in range(8):
+      crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+    _CRC_TABLE.append(crc)
+
+
+_build_table()
+
+
+def crc32c(data: bytes, value: int = 0) -> int:
+  crc = value ^ 0xFFFFFFFF
+  table = _CRC_TABLE
+  for b in data:
+    crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+  return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+  crc = crc32c(data)
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+class TFRecordWriter:
+  """Writes TFRecord files; gzip-compressed when path ends with .gz."""
+
+  def __init__(self, path: str, compression: Optional[str] = None):
+    if compression is None and path.endswith('.gz'):
+      compression = 'GZIP'
+    if compression == 'GZIP':
+      self._f = gzip.open(path, 'wb')
+    else:
+      self._f = open(path, 'wb')
+
+  def write(self, record: bytes) -> None:
+    header = struct.pack('<Q', len(record))
+    self._f.write(header)
+    self._f.write(struct.pack('<I', _masked_crc(header)))
+    self._f.write(record)
+    self._f.write(struct.pack('<I', _masked_crc(record)))
+
+  def close(self) -> None:
+    self._f.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+class TFRecordReader:
+  """Iterates serialized records from a TFRecord file."""
+
+  def __init__(self, path: str, compression: Optional[str] = None,
+               check_crc: bool = False):
+    if compression is None and path.endswith('.gz'):
+      compression = 'GZIP'
+    if compression == 'GZIP':
+      self._f = gzip.open(path, 'rb')
+    else:
+      self._f = open(path, 'rb')
+    self._check_crc = check_crc
+
+  def __iter__(self) -> Iterator[bytes]:
+    read = self._f.read
+    while True:
+      header = read(8)
+      if not header:
+        return
+      if len(header) != 8:
+        raise IOError('truncated TFRecord length header')
+      (length,) = struct.unpack('<Q', header)
+      len_crc = read(4)
+      data = read(length)
+      data_crc = read(4)
+      if len(data) != length or len(data_crc) != 4:
+        raise IOError('truncated TFRecord payload')
+      if self._check_crc:
+        if struct.unpack('<I', len_crc)[0] != _masked_crc(header):
+          raise IOError('TFRecord length crc mismatch')
+        if struct.unpack('<I', data_crc)[0] != _masked_crc(data):
+          raise IOError('TFRecord data crc mismatch')
+      yield data
+
+  def close(self) -> None:
+    self._f.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def glob_paths(patterns: Union[str, Iterable[str]]) -> List[str]:
+  if isinstance(patterns, str):
+    patterns = [patterns]
+  out: List[str] = []
+  for p in patterns:
+    matches = sorted(globlib.glob(p))
+    out.extend(matches if matches else ([p] if '*' not in p else []))
+  return out
+
+
+def read_tfrecords(patterns: Union[str, Iterable[str]],
+                   check_crc: bool = False) -> Iterator[bytes]:
+  """Yields all serialized records matching the glob pattern(s)."""
+  for path in glob_paths(patterns):
+    with TFRecordReader(path, check_crc=check_crc) as reader:
+      yield from reader

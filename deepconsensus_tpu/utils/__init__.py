@@ -1,0 +1,9 @@
+from deepconsensus_tpu.utils.phred import (  # noqa: F401
+    avg_phred,
+    encoded_sequence_to_string,
+    left_shift,
+    left_shift_seq,
+    quality_score_to_string,
+    quality_scores_to_string,
+    quality_string_to_array,
+)

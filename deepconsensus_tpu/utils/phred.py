@@ -1,0 +1,61 @@
+"""Phred-quality and sequence helpers (numpy domain).
+
+Behavior parity with reference deepconsensus/utils/utils.py:36-118; jax
+variants of left-shift live in models/losses (they operate on device).
+"""
+from __future__ import annotations
+
+from typing import List, Union
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+
+
+def encoded_sequence_to_string(encoded_sequence: np.ndarray) -> str:
+  """Vocab-int array -> string, e.g. [1,2,0] -> 'AT '."""
+  idx = np.asarray(encoded_sequence).astype(np.int64)
+  return constants.VOCAB_BYTES[idx].tobytes().decode('ascii')
+
+
+def quality_score_to_string(score: int) -> str:
+  """Phred int -> FASTQ char (offset 33)."""
+  return chr(score + 33)
+
+
+def quality_scores_to_string(scores: Union[np.ndarray, List[int]]) -> str:
+  """Phred int array -> FASTQ quality string."""
+  arr = (np.asarray(scores, dtype=np.int64) + 33).astype(np.uint8)
+  return arr.tobytes().decode('ascii')
+
+
+def quality_string_to_array(quality_string: str) -> List[int]:
+  """FASTQ quality string -> list of phred ints."""
+  return [ord(char) - 33 for char in quality_string]
+
+
+def avg_phred(base_qualities: Union[np.ndarray, List[int]]) -> float:
+  """Average quality of a read, computed in probability domain.
+
+  Negative entries encode spacing and are excluded
+  (reference: utils.py:88-106).
+  """
+  base_qualities = np.asarray(base_qualities)
+  base_qualities = base_qualities[base_qualities >= 0]
+  if not base_qualities.any():
+    return 0.0
+  probs = 10 ** (base_qualities / -10.0)
+  avg_prob = probs.sum() / len(probs)
+  return float(-10 * np.log10(avg_prob))
+
+
+def left_shift_seq(seq: np.ndarray) -> np.ndarray:
+  """Moves all gap tokens to the end, preserving base order."""
+  return np.concatenate(
+      [seq[seq != constants.GAP_INT], seq[seq == constants.GAP_INT]]
+  )
+
+
+def left_shift(batch_seq: np.ndarray, axis: int = 1) -> np.ndarray:
+  """Batched left_shift_seq."""
+  return np.apply_along_axis(left_shift_seq, axis, batch_seq)

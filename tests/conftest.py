@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax loads.
+
+Multi-chip sharding is validated on virtual CPU devices since tests run
+off-TPU; real-TPU execution is exercised by bench.py and the driver's
+compile checks.
+"""
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (
+      _flags + ' --xla_force_host_platform_device_count=8'
+  ).strip()
+
+import pathlib
+
+import pytest
+
+REFERENCE_TESTDATA = pathlib.Path('/root/reference/deepconsensus/testdata')
+
+
+@pytest.fixture(scope='session')
+def testdata_dir() -> pathlib.Path:
+  if not REFERENCE_TESTDATA.exists():
+    pytest.skip('reference testdata not available')
+  return REFERENCE_TESTDATA

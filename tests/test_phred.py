@@ -1,0 +1,51 @@
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.utils import phred
+
+
+def test_vocab_layout():
+  assert constants.SEQ_VOCAB == ' ATCG'
+  assert constants.GAP_INT == 0
+  assert constants.SEQ_VOCAB_SIZE == 5
+
+
+def test_encoded_sequence_to_string():
+  assert phred.encoded_sequence_to_string(np.array([1, 2, 0, 3, 4])) == 'AT CG'
+
+
+def test_quality_string_roundtrip():
+  scores = [0, 10, 20, 40, 93]
+  s = phred.quality_scores_to_string(scores)
+  assert s == '!+5I~'
+  assert phred.quality_string_to_array(s) == scores
+  assert phred.quality_score_to_string(0) == '!'
+
+
+def test_avg_phred_prob_domain():
+  # Mean in probability domain, not phred domain.
+  got = phred.avg_phred([10, 30])
+  probs = np.array([1e-1, 1e-3])
+  want = -10 * np.log10(probs.mean())
+  assert got == pytest.approx(want)
+
+
+def test_avg_phred_ignores_negative():
+  assert phred.avg_phred([-1, -1, 20]) == pytest.approx(20.0)
+  assert phred.avg_phred([-1, -1]) == 0.0
+  assert phred.avg_phred([0, 0]) == 0.0
+
+
+def test_left_shift_seq():
+  seq = np.array([0, 1, 0, 2, 3, 0])
+  np.testing.assert_array_equal(
+      phred.left_shift_seq(seq), np.array([1, 2, 3, 0, 0, 0])
+  )
+
+
+def test_left_shift_batch():
+  batch = np.array([[0, 1, 0, 2], [4, 0, 0, 3]])
+  np.testing.assert_array_equal(
+      phred.left_shift(batch), np.array([[1, 2, 0, 0], [4, 3, 0, 0]])
+  )
