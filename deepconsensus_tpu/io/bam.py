@@ -1,0 +1,322 @@
+"""Dependency-free BAM reading (BGZF + BAM record + aux tag parsing).
+
+The reference relies on pysam/htslib for all BAM I/O
+(reference: deepconsensus/preprocess/pre_lib.py:50-91,966-998). This
+module implements the BAM spec (SAMv1, section 4) directly so the
+framework needs no native htslib: BGZF files are concatenated gzip
+members, which Python's gzip module decompresses transparently; records
+are fixed-layout structs parsed with struct/numpy.
+
+A C++ accelerated reader (ops/native) can drop in behind the same API;
+this file is the always-available fallback and the semantics reference.
+"""
+from __future__ import annotations
+
+import gzip
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+
+# 4-bit encoded base alphabet from the SAM spec.
+SEQ_NIBBLE = '=ACMGRSVTWYHKDBN'
+_NIBBLE_LUT = np.frombuffer(SEQ_NIBBLE.encode('ascii'), dtype=np.uint8)
+
+# flag bits
+FUNMAP = 0x4
+FREVERSE = 0x10
+FSECONDARY = 0x100
+FSUPPLEMENTARY = 0x800
+
+_TAG_FMT = {
+    ord('A'): ('c', 1),
+    ord('c'): ('b', 1),
+    ord('C'): ('B', 1),
+    ord('s'): ('h', 2),
+    ord('S'): ('H', 2),
+    ord('i'): ('i', 4),
+    ord('I'): ('I', 4),
+    ord('f'): ('f', 4),
+}
+
+_B_DTYPES = {
+    ord('c'): np.int8,
+    ord('C'): np.uint8,
+    ord('s'): np.int16,
+    ord('S'): np.uint16,
+    ord('i'): np.int32,
+    ord('I'): np.uint32,
+    ord('f'): np.float32,
+}
+
+# Ops consuming query bases / reference bases (SAMv1 table).
+_QUERY_OPS = np.array([1, 1, 0, 0, 1, 0, 0, 1, 1, 0], dtype=bool)
+_REF_OPS = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1, 0], dtype=bool)
+
+
+@dataclass
+class BamRecord:
+  """One BAM alignment record."""
+
+  qname: str
+  flag: int
+  ref_id: int
+  pos: int  # 0-based leftmost coordinate
+  mapq: int
+  cigar_ops: np.ndarray  # uint8 op codes
+  cigar_lens: np.ndarray  # int32 lengths
+  seq: str
+  quals: Optional[np.ndarray]  # int32 phred values, None if absent (0xff)
+  tags: Dict[str, Any] = field(default_factory=dict)
+  reference_name: Optional[str] = None
+
+  @property
+  def is_unmapped(self) -> bool:
+    return bool(self.flag & FUNMAP)
+
+  @property
+  def is_reverse(self) -> bool:
+    return bool(self.flag & FREVERSE)
+
+  @property
+  def is_supplementary(self) -> bool:
+    return bool(self.flag & FSUPPLEMENTARY)
+
+  @property
+  def is_secondary(self) -> bool:
+    return bool(self.flag & FSECONDARY)
+
+  @property
+  def cigartuples(self) -> List[Tuple[int, int]]:
+    return list(zip(self.cigar_ops.tolist(), self.cigar_lens.tolist()))
+
+  def get_tag(self, name: str):
+    return self.tags[name]
+
+  def has_tag(self, name: str) -> bool:
+    return name in self.tags
+
+  @property
+  def query_alignment_start(self) -> int:
+    """Index of the first non-soft-clipped base of seq."""
+    start = 0
+    for op, ln in zip(self.cigar_ops, self.cigar_lens):
+      if op == constants.Cigar.SOFT_CLIP:
+        start += int(ln)
+      elif op != constants.Cigar.HARD_CLIP:
+        break
+    return start
+
+  @property
+  def query_alignment_end(self) -> int:
+    """One past the last non-soft-clipped base of seq."""
+    end = len(self.seq)
+    for op, ln in zip(self.cigar_ops[::-1], self.cigar_lens[::-1]):
+      if op == constants.Cigar.SOFT_CLIP:
+        end -= int(ln)
+      elif op != constants.Cigar.HARD_CLIP:
+        break
+    return end
+
+  def expanded_cigar(self) -> np.ndarray:
+    """Per-position cigar ops (uint8), hard clips excluded."""
+    keep = self.cigar_ops != constants.Cigar.HARD_CLIP
+    return np.repeat(self.cigar_ops[keep], self.cigar_lens[keep])
+
+  def aligned_index_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized equivalent of pysam get_aligned_pairs().
+
+    Returns (read_idx, ref_idx): for every alignment column (expanded
+    cigar without hard clips), the query index or -1, and the reference
+    index or -1 (reference: pre_lib.py:1157-1161).
+    """
+    ops = self.expanded_cigar()
+    q_mask = _QUERY_OPS[ops]
+    r_mask = _REF_OPS[ops]
+    read_idx = np.where(q_mask, np.cumsum(q_mask) - 1, -1).astype(np.int64)
+    ref_idx = np.where(r_mask, self.pos + np.cumsum(r_mask) - 1, -1).astype(
+        np.int64
+    )
+    return read_idx, ref_idx
+
+
+def _parse_tags(buf: memoryview) -> Dict[str, Any]:
+  tags: Dict[str, Any] = {}
+  pos = 0
+  n = len(buf)
+  raw = bytes(buf)
+  while pos < n - 2:
+    tag = raw[pos : pos + 2].decode('ascii')
+    val_type = raw[pos + 2]
+    pos += 3
+    if val_type in _TAG_FMT:
+      fmt, size = _TAG_FMT[val_type]
+      (value,) = struct.unpack_from('<' + fmt, raw, pos)
+      if val_type == ord('A'):
+        value = value.decode('ascii')
+      pos += size
+    elif val_type in (ord('Z'), ord('H')):
+      end = raw.index(b'\x00', pos)
+      value = raw[pos:end].decode('ascii')
+      pos = end + 1
+    elif val_type == ord('B'):
+      subtype = raw[pos]
+      (count,) = struct.unpack_from('<I', raw, pos + 1)
+      dtype = _B_DTYPES[subtype]
+      itemsize = np.dtype(dtype).itemsize
+      value = np.frombuffer(
+          raw, dtype=dtype, count=count, offset=pos + 5
+      ).copy()
+      pos += 5 + count * itemsize
+    else:
+      raise ValueError(f'unknown BAM tag type {chr(val_type)!r}')
+    tags[tag] = value
+  return tags
+
+
+def parse_record(data: bytes, references: List[str]) -> BamRecord:
+  """Parses one BAM alignment block (excluding the block_size prefix)."""
+  (
+      ref_id,
+      pos,
+      l_read_name,
+      mapq,
+      _bin,
+      n_cigar_op,
+      flag,
+      l_seq,
+      _next_ref,
+      _next_pos,
+      _tlen,
+  ) = struct.unpack_from('<iiBBHHHiiii', data, 0)
+  off = 32
+  qname = data[off : off + l_read_name - 1].decode('ascii')
+  off += l_read_name
+  cigar_raw = np.frombuffer(data, dtype=np.uint32, count=n_cigar_op, offset=off)
+  cigar_ops = (cigar_raw & 0xF).astype(np.uint8)
+  cigar_lens = (cigar_raw >> 4).astype(np.int32)
+  off += 4 * n_cigar_op
+  n_seq_bytes = (l_seq + 1) // 2
+  packed = np.frombuffer(data, dtype=np.uint8, count=n_seq_bytes, offset=off)
+  nibbles = np.empty(n_seq_bytes * 2, dtype=np.uint8)
+  nibbles[0::2] = packed >> 4
+  nibbles[1::2] = packed & 0xF
+  seq = _NIBBLE_LUT[nibbles[:l_seq]].tobytes().decode('ascii')
+  off += n_seq_bytes
+  quals_raw = np.frombuffer(data, dtype=np.uint8, count=l_seq, offset=off)
+  if l_seq and quals_raw[0] == 0xFF:
+    quals = None
+  else:
+    quals = quals_raw.astype(np.int32)
+  off += l_seq
+  tags = _parse_tags(memoryview(data)[off:])
+  ref_name = references[ref_id] if 0 <= ref_id < len(references) else None
+  return BamRecord(
+      qname=qname,
+      flag=flag,
+      ref_id=ref_id,
+      pos=pos,
+      mapq=mapq,
+      cigar_ops=cigar_ops,
+      cigar_lens=cigar_lens,
+      seq=seq,
+      quals=quals,
+      tags=tags,
+      reference_name=ref_name,
+  )
+
+
+class BamReader:
+  """Streams records from a BAM file in file order."""
+
+  def __init__(self, path: str):
+    self.path = path
+    self._f = gzip.open(path, 'rb')
+    magic = self._f.read(4)
+    if magic != b'BAM\x01':
+      raise IOError(f'{path} is not a BAM file (magic={magic!r})')
+    (l_text,) = struct.unpack('<i', self._f.read(4))
+    self.header_text = self._f.read(l_text).decode('utf-8', errors='replace')
+    (n_ref,) = struct.unpack('<i', self._f.read(4))
+    self.references: List[str] = []
+    self.reference_lengths: List[int] = []
+    for _ in range(n_ref):
+      (l_name,) = struct.unpack('<i', self._f.read(4))
+      name = self._f.read(l_name)[:-1].decode('ascii')
+      (l_ref,) = struct.unpack('<i', self._f.read(4))
+      self.references.append(name)
+      self.reference_lengths.append(l_ref)
+
+  def __iter__(self) -> Iterator[BamRecord]:
+    read = self._f.read
+    refs = self.references
+    while True:
+      size_bytes = read(4)
+      if not size_bytes:
+        return
+      if len(size_bytes) != 4:
+        raise IOError('truncated BAM record header')
+      (block_size,) = struct.unpack('<i', size_bytes)
+      data = read(block_size)
+      if len(data) != block_size:
+        raise IOError('truncated BAM record')
+      yield parse_record(data, refs)
+
+  def close(self) -> None:
+    self._f.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+class SubreadGrouper:
+  """Yields the mapped subreads of one ZMW at a time.
+
+  Relies on the input being grouped by the `zm` tag, as written by actc
+  (reference: pre_lib.py:50-91).
+  """
+
+  def __init__(self, subreads_to_ccs: str):
+    self.reader = BamReader(subreads_to_ccs)
+    self._iter = iter(self.reader)
+    self._pending: List[BamRecord] = []
+    self._zmw: Optional[int] = None
+
+  def __iter__(self) -> Iterator[List[BamRecord]]:
+    for read in self._iter:
+      if read.is_unmapped:
+        continue
+      zmw = int(read.get_tag('zm'))
+      if self._zmw is None:
+        self._zmw = zmw
+      if zmw == self._zmw:
+        self._pending.append(read)
+      else:
+        group = self._pending
+        self._pending = [read]
+        self._zmw = zmw
+        if group:
+          yield group
+    if self._pending:
+      yield self._pending
+
+
+def read_bam_by_name(path: str) -> Dict[str, List[BamRecord]]:
+  """Loads a (small) BAM keyed by reference name, e.g. truth_to_ccs.
+
+  Replaces pysam's indexed fetch(ccs_seqname) used for label lookup
+  (reference: pre_lib.py:1001-1014) with a single in-memory pass.
+  """
+  by_ref: Dict[str, List[BamRecord]] = {}
+  with BamReader(path) as reader:
+    for record in reader:
+      if record.is_unmapped or record.reference_name is None:
+        continue
+      by_ref.setdefault(record.reference_name, []).append(record)
+  return by_ref

@@ -1,0 +1,73 @@
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.io import bam
+
+
+def test_read_subreads_bam(testdata_dir):
+  path = str(testdata_dir / 'human_1m/subreads_to_ccs.bam')
+  reader = bam.BamReader(path)
+  assert reader.references  # one ccs reference per ZMW
+  records = []
+  for i, rec in enumerate(reader):
+    records.append(rec)
+    if i >= 9:
+      break
+  first = records[0]
+  assert first.qname
+  assert set(first.seq) <= set('ACGTN')
+  assert first.has_tag('zm')
+  assert first.has_tag('pw') and first.has_tag('ip') and first.has_tag('sn')
+  pw = first.get_tag('pw')
+  assert isinstance(pw, np.ndarray)
+  assert len(pw) == len(first.seq)
+  sn = first.get_tag('sn')
+  assert len(sn) == 4 and sn.dtype == np.float32
+
+
+def test_ccs_bam_has_quals_and_aux(testdata_dir):
+  path = str(testdata_dir / 'human_1m/ccs.bam')
+  rec = next(iter(bam.BamReader(path)))
+  assert rec.qname.endswith('/ccs')
+  assert rec.quals is not None
+  assert rec.quals.min() >= 0
+  assert 'np' in rec.tags and 'rq' in rec.tags
+
+
+def test_subread_grouper_groups_by_zmw(testdata_dir):
+  path = str(testdata_dir / 'human_1m/subreads_to_ccs.bam')
+  groups = list(bam.SubreadGrouper(path))
+  assert len(groups) == 10  # n_zmw_processed in the bundled summary
+  for group in groups:
+    zmws = {int(r.get_tag('zm')) for r in group}
+    assert len(zmws) == 1
+    assert all(not r.is_unmapped for r in group)
+
+
+def test_aligned_index_arrays_consistency(testdata_dir):
+  path = str(testdata_dir / 'human_1m/subreads_to_ccs.bam')
+  for i, rec in enumerate(bam.BamReader(path)):
+    read_idx, ref_idx = rec.aligned_index_arrays()
+    # Every base of seq appears exactly once in query-consuming columns.
+    n_query = (read_idx >= 0).sum()
+    assert n_query == len(rec.seq)
+    covered = read_idx[read_idx >= 0]
+    np.testing.assert_array_equal(covered, np.arange(len(rec.seq)))
+    # Reference columns are increasing, starting at pos.
+    refs = ref_idx[ref_idx >= 0]
+    if len(refs):
+      assert refs[0] == rec.pos
+      assert np.all(np.diff(refs) == 1)
+    # Expanded cigar length matches the number of columns.
+    assert len(rec.expanded_cigar()) == len(read_idx)
+    if i >= 20:
+      break
+
+
+def test_read_truth_bam_by_name(testdata_dir):
+  path = str(testdata_dir / 'human_1m/truth_to_ccs.bam')
+  by_ref = bam.read_bam_by_name(path)
+  assert by_ref
+  for name, records in by_ref.items():
+    assert name.endswith('/ccs')
+    assert all(r.reference_name == name for r in records)
