@@ -1,0 +1,15 @@
+from deepconsensus_tpu.preprocess.alignment import (  # noqa: F401
+    AlignedRead,
+    construct_ccs_read,
+    expand_aligned_record,
+)
+from deepconsensus_tpu.preprocess.spacing import space_out_reads  # noqa: F401
+from deepconsensus_tpu.preprocess.pileup import (  # noqa: F401
+    FeatureLayout,
+    Pileup,
+    layout_from_shape,
+)
+from deepconsensus_tpu.preprocess.feeder import (  # noqa: F401
+    create_proc_feeder,
+    reads_to_pileup,
+)

@@ -1,0 +1,180 @@
+"""ZMW stream assembly: subread groups + CCS draft + optional labels.
+
+Equivalent of the reference's create_proc_feeder/subreads_to_dc_example
+(reference: deepconsensus/preprocess/pre_lib.py:1279-1384) on top of the
+dependency-free BAM reader.
+"""
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from typing import Any, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.io import bam
+from deepconsensus_tpu.preprocess.alignment import (
+    AlignedRead,
+    construct_ccs_read,
+    expand_aligned_record,
+)
+from deepconsensus_tpu.preprocess.pileup import FeatureLayout, Pileup
+from deepconsensus_tpu.preprocess.spacing import space_out_reads
+
+Issue = constants.Issue
+
+log = logging.getLogger(__name__)
+
+
+def read_truth_bedfile(truth_bed: str) -> Dict[str, Dict[str, Any]]:
+  """ccs_seqname -> {contig, begin, end} (reference: pre_lib.py:1017-1025)."""
+  bed_coords = {}
+  with open(truth_bed) as bedfile:
+    for line in bedfile:
+      contig, begin, end, ccs_seqname = line.strip().split('\t')[:4]
+      bed_coords[ccs_seqname] = {
+          'contig': contig,
+          'begin': int(begin),
+          'end': int(end),
+      }
+  return bed_coords
+
+
+def read_truth_split(split_fname: str) -> Dict[str, str]:
+  """contig -> train/eval/test via genome inferred from the filename
+  (reference: pre_lib.py:1028-1058)."""
+  lower = split_fname.lower()
+  if any(x in lower for x in ('chm13', 'hg00', 'human')):
+    genome = 'HUMAN'
+  elif 'maize' in lower:
+    genome = 'MAIZE'
+  else:
+    raise ValueError(
+        f'{split_fname} does not correspond to a known genome; expected the '
+        'filename to contain one of chm13/hg00/human/maize'
+    )
+  split_regions = {}
+  for chrom in constants.TRAIN_REGIONS[genome]:
+    split_regions[chrom] = 'train'
+  for chrom in constants.EVAL_REGIONS[genome]:
+    split_regions[chrom] = 'eval'
+  for chrom in constants.TEST_REGIONS[genome]:
+    split_regions[chrom] = 'test'
+  contig_split = {}
+  with open(split_fname) as f:
+    for line in f:
+      contig, chrom = line.split()
+      if chrom in split_regions:
+        contig_split[contig] = split_regions[chrom]
+  return contig_split
+
+
+def fetch_label_alignment(
+    ccs_seqname: str,
+    truth_by_ref: Dict[str, List[bam.BamRecord]],
+    truth_range: Dict[str, Any],
+) -> Union[constants.Issue, AlignedRead]:
+  """Expands the truth alignment for one CCS (pre_lib.py:1001-1014)."""
+  records = truth_by_ref.get(ccs_seqname)
+  if not records:
+    return Issue.TRUTH_ALIGNMENT_NOT_FOUND
+  truth_alignment = records[0]
+  if truth_alignment.is_supplementary:
+    return Issue.SUPP_TRUTH_ALIGNMENT
+  return expand_aligned_record(truth_alignment, truth_range=truth_range)
+
+
+ZmwInput = Tuple[List[AlignedRead], str, FeatureLayout, str,
+                 Optional[np.ndarray]]
+
+
+def create_proc_feeder(
+    subreads_to_ccs: str,
+    ccs_bam: str,
+    layout: FeatureLayout,
+    ins_trim: int = 0,
+    use_ccs_smart_windows: bool = False,
+    truth_bed: Optional[str] = None,
+    truth_to_ccs: Optional[str] = None,
+    truth_split: Optional[str] = None,
+    limit: int = 0,
+):
+  """Returns (generator_fn, counter) yielding per-ZMW work items."""
+  main_counter: Counter = Counter()
+  grouper = bam.SubreadGrouper(subreads_to_ccs)
+  ccs_iter = iter(bam.BamReader(ccs_bam))
+
+  is_training = bool(truth_bed and truth_to_ccs and truth_split)
+  if is_training:
+    truth_by_ref = bam.read_bam_by_name(truth_to_ccs)
+    truth_ref_coords = read_truth_bedfile(truth_bed)
+    truth_split_dict = read_truth_split(truth_split)
+
+  def proc_feeder() -> Iterator[ZmwInput]:
+    for read_set in grouper:
+      main_counter['n_zmw_processed'] += 1
+      subreads = [
+          expand_aligned_record(rec, ins_trim=ins_trim, counter=main_counter)
+          for rec in read_set
+      ]
+      ccs_seqname = read_set[0].reference_name
+      # The ccs bam is ordered like the subread bam; skip CCS reads with
+      # no mapped subreads (reference: pre_lib.py:1320-1326).
+      for ccs_record in ccs_iter:
+        if ccs_record.qname == ccs_seqname:
+          break
+      else:
+        raise ValueError(f'ccs bam does not contain {ccs_seqname}')
+
+      ccs_read = construct_ccs_read(ccs_record)
+      window_widths = None
+      if use_ccs_smart_windows:
+        window_widths = np.asarray(ccs_record.get_tag('wl'))
+      subreads.append(ccs_read)
+
+      if is_training:
+        truth_range = truth_ref_coords.get(ccs_seqname)
+        if not truth_range:
+          log.info('No truth_range defined for %s.', ccs_seqname)
+          main_counter['n_zmw_missing_truth_range'] += 1
+          continue
+        label = fetch_label_alignment(ccs_seqname, truth_by_ref, truth_range)
+        if label is Issue.TRUTH_ALIGNMENT_NOT_FOUND:
+          log.info('Unable to fetch label alignment for %s.', ccs_seqname)
+          main_counter['n_zmw_no_label_alignment'] += 1
+          continue
+        if label is Issue.SUPP_TRUTH_ALIGNMENT:
+          main_counter['n_zmw_truth_label_supp_alignment'] += 1
+          continue
+        subreads.append(label)
+        split = truth_split_dict.get(truth_range['contig'])
+        if not split:
+          log.info('No split defined for %s.', ccs_seqname)
+          main_counter['n_zmw_missing_contig_split'] += 1
+          continue
+      else:
+        split = 'inference'
+      main_counter[f'n_zmw_{split}'] += 1
+      main_counter['n_zmw_pass'] += 1
+      yield (subreads, ccs_seqname, layout, split, window_widths)
+      if limit and main_counter['n_zmw_pass'] >= limit:
+        break
+
+  return proc_feeder, main_counter
+
+
+def reads_to_pileup(
+    subreads: List[AlignedRead],
+    ccs_seqname: str,
+    layout: FeatureLayout,
+    window_widths: Optional[np.ndarray] = None,
+) -> Pileup:
+  """Spaces a ZMW's reads into a Pileup (pre_lib.py:1370-1384)."""
+  spaced = space_out_reads(subreads)
+  return Pileup(
+      name=ccs_seqname,
+      reads=spaced,
+      layout=layout,
+      window_widths=window_widths,
+  )

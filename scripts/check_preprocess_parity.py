@@ -1,0 +1,107 @@
+"""Compare our preprocess output against the reference's bundled TFRecords."""
+import collections
+import sys
+
+import numpy as np
+
+sys.path.insert(0, '/root/repo')
+
+from deepconsensus_tpu.io import tfrecord
+from deepconsensus_tpu.io.example_proto import Example
+from deepconsensus_tpu.preprocess import FeatureLayout, create_proc_feeder, reads_to_pileup
+
+TD = '/root/reference/deepconsensus/testdata/human_1m'
+
+
+def load_reference_examples():
+  ref = {}
+  for split in ('train', 'eval', 'test'):
+    for raw in tfrecord.read_tfrecords(f'{TD}/tf_examples/{split}/{split}.tfrecord.gz'):
+      ex = Example.parse(raw)
+      name = ex['name'][0].decode()
+      pos = ex['window_pos'][0]
+      ref[(name, pos)] = (split, ex)
+  return ref
+
+
+def main():
+  layout = FeatureLayout(max_passes=20, max_length=100)
+  feeder, counter = create_proc_feeder(
+      subreads_to_ccs=f'{TD}/subreads_to_ccs.bam',
+      ccs_bam=f'{TD}/ccs.bam',
+      layout=layout,
+      ins_trim=5,
+      truth_bed=f'{TD}/truth.bed',
+      truth_to_ccs=f'{TD}/truth_to_ccs.bam',
+      truth_split=f'{TD}/truth_split.tsv',
+  )
+  ours = {}
+  split_counts = collections.Counter()
+  agg = collections.Counter()
+  for subreads, name, lay, split, ww in feeder():
+    pileup = reads_to_pileup(subreads, name, lay, ww)
+    for window in pileup.iter_windows():
+      ex = window.to_example()
+      pos = window.ccs.ccs_bounds.start
+      ours[(window.name, pos)] = (split, ex)
+      split_counts[split] += 1
+    agg.update(pileup.counter)
+  print('counters:', dict(counter))
+  print('agg window counters:', dict(agg))
+  print('ours per split:', dict(split_counts))
+
+  ref = load_reference_examples()
+  print(f'ref examples: {len(ref)}, ours: {len(ours)}')
+  missing = set(ref) - set(ours)
+  extra = set(ours) - set(ref)
+  print(f'missing: {len(missing)} extra: {len(extra)}')
+  for k in list(missing)[:5]:
+    print('  missing:', k, ref[k][0])
+  for k in list(extra)[:5]:
+    print('  extra:', k, ours[k][0])
+
+  n_exact = n_rows_diff = n_label_diff = n_meta_diff = 0
+  first_diff = None
+  for key in sorted(set(ref) & set(ours)):
+    rsplit, rex = ref[key]
+    osplit, oex = ours[key]
+    ok = True
+    if rsplit != osplit:
+      n_meta_diff += 1
+      ok = False
+    r_rows = np.frombuffer(rex['subreads/encoded'][0], np.float32)
+    o_rows = np.frombuffer(oex['subreads/encoded'][0], np.float32)
+    if not np.array_equal(r_rows, o_rows):
+      n_rows_diff += 1
+      ok = False
+      if first_diff is None:
+        first_diff = (key, r_rows, o_rows, rex, oex)
+    if ('label/encoded' in rex) != ('label/encoded' in oex):
+      n_label_diff += 1
+      ok = False
+    elif 'label/encoded' in rex:
+      if rex['label/encoded'][0] != oex['label/encoded'][0]:
+        n_label_diff += 1
+        ok = False
+    if rex['subreads/num_passes'] != oex['subreads/num_passes']:
+      n_meta_diff += 1
+      ok = False
+    if rex['ccs_base_quality_scores'] != oex['ccs_base_quality_scores']:
+      n_meta_diff += 1
+      ok = False
+    if ok:
+      n_exact += 1
+  print(f'exact: {n_exact} rows_diff: {n_rows_diff} label_diff: {n_label_diff} meta_diff: {n_meta_diff}')
+  if first_diff is not None:
+    key, r_rows, o_rows, rex, oex = first_diff
+    r = r_rows.reshape(85, 100)
+    o = o_rows.reshape(85, 100)
+    bad_rows = np.unique(np.nonzero(r != o)[0])
+    print('first diff:', key, 'rows differing:', bad_rows[:20])
+    i = bad_rows[0]
+    print('ref row :', r[i][:50])
+    print('ours row:', o[i][:50])
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,126 @@
+"""Unit tests for the vectorized multi-read spacing model."""
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.preprocess.alignment import AlignedRead
+from deepconsensus_tpu.preprocess.spacing import space_out_reads
+
+C = constants.Cigar
+M, I = int(C.MATCH), int(C.INS)
+
+
+def make_read(seq, cigar_ops, name='m/1/0', truth_range=None, ccs_start=0):
+  bases = np.array(
+      [constants.SEQ_VOCAB.index(c) for c in seq], dtype=np.uint8
+  )
+  cigar = np.array(cigar_ops, dtype=np.uint8)
+  is_ref = np.array([op != I for op in cigar_ops])
+  ccs_idx = np.where(is_ref, ccs_start + np.cumsum(is_ref) - 1, -1).astype(
+      np.int64
+  )
+  return AlignedRead(
+      name=name,
+      bases=bases,
+      cigar=cigar,
+      pw=np.arange(1, len(seq) + 1, dtype=np.int32),
+      ip=np.arange(1, len(seq) + 1, dtype=np.int32),
+      sn=np.ones(4, dtype=np.float32),
+      strand=constants.Strand.FORWARD,
+      ccs_idx=ccs_idx,
+      truth_range=truth_range,
+  )
+
+
+def spaced_strings(reads):
+  return [str(r) for r in space_out_reads(reads)]
+
+
+def test_no_insertions_identity():
+  r1 = make_read('ACGT', [M] * 4)
+  r2 = make_read('AC T', [M] * 4)
+  out = spaced_strings([r1, r2])
+  assert out == ['ACGT', 'AC T']
+
+
+def test_single_insertion_creates_column():
+  # r1 has an insertion after its first base; r2 gets a gap there.
+  r1 = make_read('ACGT', [M, I, M, M])
+  r2 = make_read('AGT', [M, M, M])
+  out = spaced_strings([r1, r2])
+  assert out == ['ACGT', 'A GT']
+
+
+def test_insertions_left_aligned_within_block():
+  r1 = make_read('ATTG', [M, I, I, M])  # two insertions
+  r2 = make_read('ACG', [M, I, M])      # one insertion, same boundary
+  r3 = make_read('AG', [M, M])
+  out = spaced_strings([r1, r2, r3])
+  assert out == ['ATTG', 'AC G', 'A  G']
+
+
+def test_insertion_at_start():
+  r1 = make_read('TAC', [I, M, M])
+  r2 = make_read('AC', [M, M])
+  out = spaced_strings([r1, r2])
+  assert out == ['TAC', ' AC']
+
+
+def test_trailing_insertions():
+  r1 = make_read('ACT', [M, M, I])
+  r2 = make_read('AC', [M, M])
+  out = spaced_strings([r1, r2])
+  assert out == ['ACT', 'AC ']
+
+
+def test_pw_values_follow_bases():
+  r1 = make_read('ACGT', [M, I, M, M])
+  r2 = make_read('AGT', [M, M, M])
+  spaced = space_out_reads([r1, r2])
+  np.testing.assert_array_equal(spaced[0].pw, [1, 2, 3, 4])
+  np.testing.assert_array_equal(spaced[1].pw, [1, 0, 2, 3])
+
+
+def test_ccs_idx_preserved():
+  r1 = make_read('ACGT', [M, I, M, M])
+  r2 = make_read('AGT', [M, M, M])
+  spaced = space_out_reads([r1, r2])
+  np.testing.assert_array_equal(spaced[0].ccs_idx, [0, -1, 1, 2])
+  np.testing.assert_array_equal(spaced[1].ccs_idx, [0, -1, 1, 2])
+
+
+def test_label_insertions_do_not_create_columns():
+  # Label (truth) insertions are consumed eagerly; subreads don't space.
+  sub = make_read('ACG', [M, M, M])
+  ccs = make_read('ACG', [M, M, M])
+  label = make_read(
+      'ATCG', [M, I, M, M], truth_range={'contig': 'c', 'begin': 0, 'end': 4}
+  )
+  spaced = space_out_reads([sub, ccs, label])
+  # Subreads get no new columns, but the pileup width grows to fit the
+  # label, whose eager insertion consumption advances it one column
+  # past the others (reference state machine: pre_lib.py:200-216).
+  assert [str(r) for r in spaced[:2]] == ['ACG ', 'ACG ']
+  assert str(spaced[2]) == 'ATCG'
+  # Truth positions attach to read-advancing (M/I) columns only.
+  np.testing.assert_array_equal(spaced[2].truth_idx, [0, 1, 2, 3])
+
+
+def test_label_with_subread_insertions():
+  sub = make_read('ATCG', [M, I, M, M])
+  ccs = make_read('ACG', [M, M, M])
+  label = make_read(
+      'ACG', [M, M, M], truth_range={'contig': 'c', 'begin': 5, 'end': 8}
+  )
+  spaced = space_out_reads([sub, ccs, label])
+  assert str(spaced[0]) == 'ATCG'
+  assert str(spaced[1]) == 'A CG'
+  # Label gets a gap through the subread insertion column.
+  assert str(spaced[2]) == 'A CG'
+  np.testing.assert_array_equal(spaced[2].truth_idx, [5, -1, 6, 7])
+
+
+def test_all_reads_padded_to_same_width():
+  r1 = make_read('ACTTT', [M, M, I, I, I])
+  r2 = make_read('AC', [M, M])
+  spaced = space_out_reads([r1, r2])
+  assert len(spaced[0]) == len(spaced[1]) == 5
