@@ -1,0 +1,10 @@
+from deepconsensus_tpu.models.config import (  # noqa: F401
+    get_config,
+    finalize_params,
+    read_params_from_json,
+    save_params_as_json,
+)
+from deepconsensus_tpu.models.model import (  # noqa: F401
+    DeepConsensusModel,
+    get_model,
+)

@@ -1,0 +1,348 @@
+"""Model/hparam configuration system.
+
+Mirrors the reference's {model}+{dataset} ConfigDict presets and the
+hardware-dependent parameter derivation of modify_params (reference:
+deepconsensus/models/model_configs.py:40-379,
+models/model_utils.py:237-354, models/transformer_basic_params.py:33-97),
+with TPU-native additions: compute dtype, mesh axes, and kernel toggles.
+
+params.json written next to checkpoints is the source of truth at
+inference time, exactly like the reference (model_utils.py:434-476).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import ml_collections
+
+from deepconsensus_tpu.preprocess.pileup import total_rows as _total_rows
+
+# Transformer size presets (reference: transformer_basic_params.py).
+TRANSFORMER_SIZE_PARAMS = {
+    'tiny': dict(
+        num_hidden_layers=6,
+        num_heads=4,
+        filter_size=256,
+    ),
+    'base': dict(
+        num_hidden_layers=6,
+        num_heads=8,
+        filter_size=2048,
+    ),
+    'big': dict(
+        num_hidden_layers=6,
+        num_heads=16,
+        filter_size=4096,
+    ),
+}
+
+
+def _set_base_transformer_hparams(params):
+  params.model_name = 'transformer'
+  params.add_pos_encoding = True
+  params.num_heads = 2
+  params.layer_norm = False
+  params.rezero = True
+  params.condense_transformer_input = False
+  params.transformer_model_size = 'base'
+  # Band half-width; full band is 2*attn_win_size+1 columns.
+  params.attn_win_size = 12
+
+  params.num_channels = 1
+  params.per_base_hidden_size = 1
+  params.pw_hidden_size = 1
+  params.ip_hidden_size = 1
+  params.sn_hidden_size = 1
+  params.ccs_bq_hidden_size = 1
+  params.strand_hidden_size = 1
+
+  params.layer_postprocess_dropout = 0.1
+  params.attention_dropout = 0.1
+  params.relu_dropout = 0.1
+
+  params.batch_size = 256
+  params.num_epochs = 9
+  params.num_epochs_for_decay = 9
+  params.buffer_size = 1_000_000
+
+  params.initial_learning_rate = 3.6246e-3
+  params.end_learning_rate = 2.86594e-5
+  params.warmup_steps = 35536
+  params.weight_decay_rate = 6.9868e-3
+  params.beta_1 = 0.9
+  params.beta_2 = 0.999
+  params.epsilon = 1e-6
+
+
+def _set_transformer_learned_embeddings_hparams(params):
+  _set_base_transformer_hparams(params)
+  params.model_name = 'transformer_learn_values'
+  params.per_base_hidden_size = 8
+  params.pw_hidden_size = 8
+  params.ip_hidden_size = 8
+  params.strand_hidden_size = 2
+  params.sn_hidden_size = 8
+  params.ccs_bq_hidden_size = 8
+  params.condense_transformer_input = True
+  params.transformer_input_size = 280
+
+
+def _set_transformer_learned_embeddings_distill_hparams(params):
+  _set_transformer_learned_embeddings_hparams(params)
+  params.model_name = 'transformer_learn_values_distill'
+  params.num_hidden_layers = 5
+  params.filter_size = 2048
+  params.layer_postprocess_dropout = 0.0
+  params.attention_dropout = 0.1
+  params.relu_dropout = 0.0
+  params.init_encoder_stack = True
+  params.init_nonencoder_layers = True
+  params.teacher_encoder_layers = [1, 2, 3, 4, 5]
+  params.student_encoder_layers = [0, 1, 2, 3, 4]
+  params.warmup_steps = 0
+  params.distill_alpha = 1.0e5
+  params.student_alpha = 1.0
+  params.temperature = 1.0
+  params.logit_loss_identifier = 'mean_squared_error'
+
+
+def _set_base_fc_hparams(params):
+  params.model_name = 'fc'
+  params.fc_size = [256, 512, 256, 128]
+  params.fc_dropout = 0.0
+  params.num_channels = 1
+  params.per_base_hidden_size = 1
+  params.pw_hidden_size = 1
+  params.ip_hidden_size = 1
+  params.strand_hidden_size = 1
+  params.ccs_bq_hidden_size = 1
+  params.sn_hidden_size = 1
+  params.l2 = 0.0
+  params.batch_size = 256
+  params.num_epochs = 15
+  params.num_epochs_for_decay = 15
+  params.buffer_size = 1_000_000
+  params.initial_learning_rate = 3.6246e-3
+  params.end_learning_rate = 2.86594e-5
+  params.warmup_steps = 35536
+  params.weight_decay_rate = 6.9868e-3
+  params.beta_1 = 0.9
+  params.beta_2 = 0.999
+  params.epsilon = 1e-6
+
+
+_TESTDATA = '/root/reference/deepconsensus/testdata'
+
+
+def _set_test_data_hparams(params):
+  params.train_path = [
+      os.path.join(_TESTDATA, 'human_1m/tf_examples/train/*')
+  ]
+  params.eval_path = params.train_path
+  params.test_path = params.train_path
+  params.inference_path = os.path.join(
+      _TESTDATA, 'human_1m/tf_examples/inference/*'
+  )
+  params.n_examples_train = 253
+  params.n_examples_eval = 253
+  params.max_passes = 20
+  params.batch_size = 1
+  params.num_epochs = 1
+  params.buffer_size = 10
+  if params.model_name == 'fc':
+    params.fc_size = [4, 4]
+
+
+def _set_test_bq_data_hparams(params):
+  _set_test_data_hparams(params)
+  params.use_ccs_bq = True
+  params.train_path = [
+      os.path.join(_TESTDATA, 'human_1m/tf_examples_bq/train/*')
+  ]
+  params.eval_path = params.train_path
+  params.test_path = params.train_path
+  params.inference_path = os.path.join(
+      _TESTDATA, 'human_1m/tf_examples_bq/inference/*'
+  )
+
+
+def _set_custom_data_hparams(params):
+  params.tf_dataset = ['/path_to_training_data']
+  params.max_passes = 20
+
+
+def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
+  """Builds a ConfigDict for '{model}+{dataset}' preset names."""
+  params = ml_collections.ConfigDict()
+
+  params.trial = 1
+  params.rezero = False
+
+  params.PW_MAX = 255
+  params.IP_MAX = 255
+  params.SN_MAX = 500
+  params.CCS_BQ_MAX = 95
+  params.STRAND_MAX = 2
+
+  params.use_bases = True
+  params.use_pw = True
+  params.use_ip = True
+  params.use_strand = True
+  params.use_sn = True
+  params.use_ccs = True
+  params.use_ccs_bq = False
+  params.per_base_hidden_size = 1
+  params.pw_hidden_size = 1
+  params.ip_hidden_size = 1
+  params.sn_hidden_size = 1
+  params.strand_hidden_size = 1
+  params.ccs_bq_hidden_size = 1
+
+  params.total_rows = ml_collections.config_dict.placeholder(int)
+
+  params.vocab_size = 5
+  params.seed = 1
+  params.remove_label_gaps = False
+  params.loss_function = 'alignment_loss'
+
+  # AlignmentLoss parameters (reference: model_configs.py:320-323).
+  params.del_cost = 10.0
+  params.loss_reg = 0.1
+  params.band_width = ml_collections.config_dict.placeholder(int)
+
+  params.max_length = 100
+
+  params.model_config_name = 'transformer_learn_values'
+  params.dataset_config_name = 'ccs'
+
+  # TPU-native execution knobs (not in the reference).
+  params.dtype = 'bfloat16'          # compute dtype; params stay float32
+  params.use_pallas_attention = False
+  params.dp_axis = 'data'            # mesh axis names
+  params.tp_axis = 'model'
+  params.eval_every_n_steps = 3000
+  params.log_every_n_steps = 100
+
+  params.tpu_scale_factor = 1
+
+  if config_name is None:
+    return params
+
+  model_config_name, dataset_config_name = config_name.split('+')
+  params.model_config_name = model_config_name
+  params.dataset_config_name = dataset_config_name
+  params.tf_dataset = None
+  params.limit = -1
+  if model_config_name == 'fc':
+    _set_base_fc_hparams(params)
+  elif model_config_name == 'transformer':
+    _set_base_transformer_hparams(params)
+  elif model_config_name == 'transformer_learn_values':
+    _set_transformer_learned_embeddings_hparams(params)
+  elif model_config_name == 'transformer_learn_values_distill':
+    _set_transformer_learned_embeddings_distill_hparams(params)
+  else:
+    raise ValueError(f'Unknown model_config_name: {model_config_name}')
+
+  if dataset_config_name == 'test':
+    _set_test_data_hparams(params)
+  elif dataset_config_name == 'test_bq':
+    _set_test_bq_data_hparams(params)
+  elif dataset_config_name == 'custom':
+    _set_custom_data_hparams(params)
+  else:
+    raise ValueError(
+        f'dataset_config_name is {dataset_config_name}. Must be one of: '
+        'test, test_bq, custom'
+    )
+  return params
+
+
+def finalize_params(
+    params: ml_collections.ConfigDict,
+    max_length: Optional[int] = None,
+    num_devices: int = 1,
+    is_training: bool = True,
+) -> None:
+  """Derives dependent parameters (reference modify_params).
+
+  Batch size scales by device count (global batch = per-replica x N,
+  reference: model_utils.py:279-299); hidden size derives from the
+  enabled per-feature embedding widths.
+  """
+  with params.unlocked():
+    if not is_training:
+      for key in ('tf_dataset', 'train_path', 'eval_path', 'test_path',
+                  'inference_path'):
+        if key in params:
+          del params[key]
+
+    if num_devices > 1:
+      params.batch_size = params.batch_size * params.tpu_scale_factor
+      params.batch_size *= num_devices
+
+    if max_length is not None:
+      params.max_length = max_length
+    if 'max_length' not in params:
+      raise ValueError('No params.max_length provided.')
+
+    params.total_rows = _total_rows(params.max_passes, params.use_ccs_bq)
+
+    if 'transformer_learn_values' in params.model_name:
+      dim = (
+          params.use_bases * params.per_base_hidden_size
+          + params.use_pw * params.pw_hidden_size
+          + params.use_ip * params.ip_hidden_size
+          + params.use_strand * params.strand_hidden_size
+          + params.use_ccs_bq * params.ccs_bq_hidden_size
+      )
+      params.hidden_size = (
+          params.max_passes * dim
+          + params.use_ccs * params.per_base_hidden_size
+          + params.use_ccs_bq * params.ccs_bq_hidden_size
+          + params.use_sn * params.sn_hidden_size * 4
+      )
+    else:
+      params.hidden_size = params.total_rows
+
+    if 'transformer' in params.model_name and params.hidden_size % 2 != 0:
+      params.hidden_size += 1
+
+    if 'transformer_learn_values' in params.model_name:
+      if params.condense_transformer_input:
+        params.hidden_size = params.transformer_input_size
+    if 'transformer' in params.model_name:
+      for name, value in TRANSFORMER_SIZE_PARAMS[
+          params.get('transformer_model_size', 'base')
+      ].items():
+        if name not in params:
+          params[name] = value
+
+
+def save_params_as_json(out_dir: str, params: ml_collections.ConfigDict) -> str:
+  """Writes params.json beside checkpoints (model_utils.py:468-476)."""
+  os.makedirs(out_dir, exist_ok=True)
+  path = os.path.join(out_dir, 'params.json')
+  with open(path, 'w') as f:
+    json.dump(params.to_dict(), f, indent=2, sort_keys=True, default=str)
+  return path
+
+
+def read_params_from_json(
+    checkpoint_path: str,
+) -> ml_collections.ConfigDict:
+  """Loads params.json from a checkpoint directory or file prefix
+  (model_utils.py:434-465). Unknown keys are kept (forward compat)."""
+  if os.path.isdir(checkpoint_path):
+    json_path = os.path.join(checkpoint_path, 'params.json')
+  else:
+    json_path = os.path.join(os.path.dirname(checkpoint_path), 'params.json')
+  with open(json_path) as f:
+    loaded = json.load(f)
+  params = get_config()
+  with params.unlocked():
+    for key, value in loaded.items():
+      params[key] = value
+  return params

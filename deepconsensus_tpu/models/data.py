@@ -1,0 +1,168 @@
+"""Input pipeline: TFRecord parsing, row formatting, batching.
+
+TF-free equivalent of the reference's tf.data pipeline (reference:
+deepconsensus/models/data_providers.py:41-425): examples parse into
+numpy, PW/IP/SN rows are clipped, and batches are produced by a
+lightweight shuffling loader that feeds jax.device_put directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Union
+
+import ml_collections
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.io.example_proto import Example
+from deepconsensus_tpu.io.tfrecord import read_tfrecords
+from deepconsensus_tpu.preprocess.pileup import layout_from_shape, row_indices
+from deepconsensus_tpu.utils import phred
+
+
+def format_rows(
+    subreads: np.ndarray,
+    params: ml_collections.ConfigDict,
+) -> np.ndarray:
+  """Clips PW/IP/SN rows and crops passes to the model's max_passes
+  (reference format_rows: data_providers.py:128-184)."""
+  example_layout = layout_from_shape(subreads.shape, params.use_ccs_bq)
+  (base_r, pw_r, ip_r, strand_r, ccs_r, ccs_bq_r, sn_r) = row_indices(
+      example_layout.max_passes, params.use_ccs_bq
+  )
+  keep = params.max_passes
+
+  def rows_of(r, cap=None):
+    block = subreads[r[0]:r[1]]
+    return block[:cap] if cap else block
+
+  base_rows = rows_of(base_r, keep)
+  pw_rows = np.clip(rows_of(pw_r, keep), 0, params.PW_MAX)
+  ip_rows = np.clip(rows_of(ip_r, keep), 0, params.IP_MAX)
+  strand_rows = rows_of(strand_r, keep)
+  ccs_rows = rows_of(ccs_r)
+  sn_rows = np.clip(rows_of(sn_r), 0, params.SN_MAX)
+  if params.use_ccs_bq:
+    features = [base_rows, pw_rows, ip_rows, strand_rows, ccs_rows,
+                rows_of(ccs_bq_r), sn_rows]
+  else:
+    features = [base_rows, pw_rows, ip_rows, strand_rows, ccs_rows, sn_rows]
+  rows = np.concatenate(features, axis=0)
+  assert rows.shape == (params.total_rows, params.max_length, 1), rows.shape
+  return rows
+
+
+def parse_example(
+    raw: bytes,
+    params: ml_collections.ConfigDict,
+    inference: bool = False,
+) -> Dict[str, np.ndarray]:
+  """Parses one serialized example into formatted features
+  (reference process_input: data_providers.py:249-297)."""
+  ex = Example.parse(raw)
+  shape = ex['subreads/shape']
+  subreads = np.frombuffer(
+      ex['subreads/encoded'][0], dtype=constants.NP_DATA_TYPE
+  ).reshape(shape)
+  out = {
+      'rows': format_rows(subreads, params),
+      'num_passes': np.asarray(
+          ex['subreads/num_passes'][0], dtype=constants.NP_DATA_TYPE
+      ),
+      'window_pos': np.asarray(ex['window_pos'][0], dtype=np.int64),
+      'name': ex['name'][0],
+      'ccs_base_quality_scores': np.asarray(
+          ex['ccs_base_quality_scores'], dtype=np.int64
+      ),
+  }
+  if not inference:
+    label = np.frombuffer(
+        ex['label/encoded'][0], dtype=constants.NP_DATA_TYPE
+    ).reshape(ex['label/shape'])
+    if params.remove_label_gaps:
+      label = phred.left_shift_seq(label)
+    out['label'] = label
+  return out
+
+
+def process_feature_dict(
+    features: Dict, params: ml_collections.ConfigDict
+) -> Dict:
+  """Formats an in-memory inference feature dict
+  (reference: data_providers.py:187-223)."""
+  return {
+      'rows': format_rows(features['subreads'], params),
+      'label': np.empty(0, dtype=constants.NP_DATA_TYPE),
+      'num_passes': features['subreads/num_passes'],
+      'window_pos': features['window_pos'],
+      'name': features['name'],
+      'ccs_base_quality_scores': features['ccs_base_quality_scores'],
+      'ec': features['ec'],
+      'np_num_passes': features['np_num_passes'],
+      'rq': features['rq'],
+      'rg': features['rg'],
+  }
+
+
+@dataclasses.dataclass
+class DatasetIterator:
+  """Shuffled, repeating, fixed-batch iterator over TFRecord shards.
+
+  Eagerly loads the shard contents once (training corpora stream via
+  multiple shards; the bundled test sets fit in memory), then yields
+  (rows, label) batches. drop_remainder semantics match the reference
+  (data_providers.py:361).
+  """
+
+  patterns: Union[str, Sequence[str]]
+  params: ml_collections.ConfigDict
+  batch_size: int
+  inference: bool = False
+  seed: int = 1
+  shuffle: bool = True
+  drop_remainder: bool = True
+  limit: int = -1
+
+  def __post_init__(self):
+    self._rows: List[np.ndarray] = []
+    self._labels: List[np.ndarray] = []
+    for i, raw in enumerate(read_tfrecords(self.patterns)):
+      if 0 <= self.limit <= i:
+        break
+      parsed = parse_example(raw, self.params, self.inference)
+      self._rows.append(parsed['rows'])
+      if not self.inference:
+        self._labels.append(parsed['label'])
+    if not self._rows:
+      raise ValueError(f'no examples matched {self.patterns!r}')
+    self.rows = np.stack(self._rows)
+    self.labels = np.stack(self._labels) if self._labels else None
+    self._rng = np.random.default_rng(self.seed)
+
+  def __len__(self) -> int:
+    return len(self.rows)
+
+  @property
+  def steps_per_epoch(self) -> int:
+    if self.drop_remainder:
+      return len(self.rows) // self.batch_size
+    return -(-len(self.rows) // self.batch_size)
+
+  def epoch(self) -> Iterator[Dict[str, np.ndarray]]:
+    order = np.arange(len(self.rows))
+    if self.shuffle:
+      self._rng.shuffle(order)
+    n = len(order)
+    stop = (
+        n - n % self.batch_size if self.drop_remainder else n
+    )
+    for start in range(0, stop, self.batch_size):
+      idx = order[start : start + self.batch_size]
+      batch = {'rows': self.rows[idx]}
+      if self.labels is not None:
+        batch['label'] = self.labels[idx]
+      yield batch
+
+  def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+    while True:
+      yield from self.epoch()

@@ -1,0 +1,162 @@
+"""Model construction, shapes, and forward-pass invariants
+(modeled on reference networks_test.py coverage)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import data as data_lib
+from deepconsensus_tpu.models import model as model_lib
+
+
+def make_params(name='transformer_learn_values+test', **overrides):
+  params = config_lib.get_config(name)
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'  # deterministic numerics on CPU tests
+    for k, v in overrides.items():
+      params[k] = v
+  return params
+
+
+def fake_rows(params, batch=2, seed=0):
+  rng = np.random.default_rng(seed)
+  rows = np.zeros(
+      (batch, params.total_rows, params.max_length, 1), dtype=np.float32
+  )
+  mp = params.max_passes
+  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)
+  rows[:, mp : 2 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 2 * mp : 3 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 3 * mp : 4 * mp] = rng.integers(0, 3, size=rows[:, :mp].shape)
+  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)
+  rows[:, 4 * mp + 1 :] = rng.integers(0, 501, size=rows[:, 4 * mp + 1 :].shape)
+  return jnp.asarray(rows)
+
+
+def test_hidden_size_derivation():
+  params = make_params()
+  # 20 passes * (8+8+8+2) + ccs 8 + sn 4*8 = 560, condensed to 280.
+  assert params.total_rows == 85
+  assert params.hidden_size == 280
+  assert params.transformer_input_size == 280
+
+
+def test_forward_shapes_and_softmax():
+  params = make_params()
+  model = model_lib.get_model(params)
+  rows = fake_rows(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  preds = model.apply(variables, rows)
+  assert preds.shape == (2, params.max_length, 5)
+  np.testing.assert_allclose(
+      np.asarray(preds.sum(-1)), np.ones((2, params.max_length)), atol=1e-5
+  )
+
+
+def test_intermediates_exposed():
+  params = make_params()
+  model = model_lib.get_model(params)
+  rows = fake_rows(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  out = model.apply(
+      variables, rows, method=model.apply_with_intermediates
+  )
+  assert out['logits'].shape == (2, params.max_length, 5)
+  assert out['final_output'].shape == (2, params.max_length, 280)
+
+
+@pytest.mark.parametrize('win', [0, 6, 12, None])
+def test_attention_window_sweep(win):
+  params = make_params()
+  with params.unlocked():
+    params.attn_win_size = win
+  model = model_lib.get_model(params)
+  rows = fake_rows(params, batch=1)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  preds = model.apply(variables, rows)
+  assert np.isfinite(np.asarray(preds)).all()
+
+
+def test_rezero_starts_as_identity_plus_embedding():
+  """With ReZero alphas at 0, the encoder stack is the identity, so two
+  different inits differ only through embeddings/condenser/logits."""
+  params = make_params()
+  model = model_lib.get_model(params)
+  rows = fake_rows(params, batch=1)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  alphas = [
+      np.asarray(v)
+      for k, v in jax.tree_util.tree_flatten_with_path(variables)[0]
+      if 'alpha' in str(k)
+  ]
+  assert len(alphas) == 2 * params.num_hidden_layers
+  assert all(a == 0.0 for a in alphas)
+
+
+def test_masked_embedding_zero_id():
+  emb = model_lib.MaskedEmbed(vocab_size=5, features=8)
+  variables = emb.init(jax.random.PRNGKey(0), jnp.array([[0, 1]]))
+  out = emb.apply(variables, jnp.array([[0, 1]]))
+  np.testing.assert_array_equal(np.asarray(out[0, 0]), np.zeros(8))
+  assert np.abs(np.asarray(out[0, 1])).sum() > 0
+
+
+def test_bq_variant_builds():
+  params = make_params('transformer_learn_values+test_bq')
+  assert params.total_rows == 86
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  preds = model.apply(variables, rows)
+  assert preds.shape == (1, 100, 5)
+
+
+def test_fc_model():
+  params = make_params('fc+test')
+  model = model_lib.get_model(params)
+  rows = fake_rows(params, batch=2)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  preds = model.apply(variables, rows)
+  assert preds.shape == (2, 100, 5)
+
+
+def test_dataset_iterator_from_reference_shards(testdata_dir):
+  params = make_params()
+  ds = data_lib.DatasetIterator(
+      patterns=str(testdata_dir / 'human_1m/tf_examples/train/*'),
+      params=params,
+      batch_size=8,
+  )
+  assert len(ds) == 1239
+  batch = next(iter(ds))
+  assert batch['rows'].shape == (8, 85, 100, 1)
+  assert batch['label'].shape == (8, 100)
+  # PW/IP clipped into vocab range.
+  assert batch['rows'][:, 20:60].max() <= 255
+  assert batch['rows'][:, 61:].max() <= 500
+
+
+def test_model_runs_on_real_examples(testdata_dir):
+  params = make_params()
+  ds = data_lib.DatasetIterator(
+      patterns=str(testdata_dir / 'human_1m/tf_examples/train/*'),
+      params=params,
+      batch_size=4,
+      limit=4,
+  )
+  model = model_lib.get_model(params)
+  batch = next(iter(ds))
+  variables = model.init(jax.random.PRNGKey(0), jnp.asarray(batch['rows']))
+  preds = model.apply(variables, jnp.asarray(batch['rows']))
+  assert np.isfinite(np.asarray(preds)).all()
+
+
+def test_params_json_roundtrip(tmp_path):
+  params = make_params()
+  config_lib.save_params_as_json(str(tmp_path), params)
+  back = config_lib.read_params_from_json(str(tmp_path))
+  assert back.hidden_size == params.hidden_size
+  assert back.max_passes == params.max_passes
+  assert back.model_name == params.model_name
