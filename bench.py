@@ -1,0 +1,71 @@
+"""Benchmark: model-forward window throughput on the available chip.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline context: the reference's published quick-start runs 178 ZMWs
+end-to-end in 234.95 s on an n1-standard-16 (~0.76 ZMW/s,
+docs/quick_start.md:315-320). At the published mean of ~150 windows per
+ZMW that is ~114 windows/s; vs_baseline reports our model-window
+throughput relative to that number.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_WINDOWS_PER_SEC = 114.0
+
+
+def main():
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+
+  batch = 1024
+  model = model_lib.get_model(params)
+  rng = np.random.default_rng(0)
+  rows = np.zeros((batch, params.total_rows, params.max_length, 1),
+                  np.float32)
+  mp = params.max_passes
+  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)
+  rows[:, mp:2 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 2 * mp:3 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 3 * mp:4 * mp] = rng.integers(0, 3, size=rows[:, :mp].shape)
+  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)
+  rows[:, 4 * mp + 1:] = rng.integers(
+      0, 501, size=rows[:, 4 * mp + 1:].shape)
+  rows = jnp.asarray(rows)
+
+  variables = model.init(jax.random.PRNGKey(0), rows[:1])
+
+  @jax.jit
+  def forward(variables, rows):
+    preds = model.apply(variables, rows)
+    return jnp.argmax(preds, -1), jnp.max(preds, -1)
+
+  # Warmup/compile.
+  ids, probs = forward(variables, rows)
+  ids.block_until_ready()
+
+  n_iters = 20
+  t0 = time.perf_counter()
+  for _ in range(n_iters):
+    ids, probs = forward(variables, rows)
+  ids.block_until_ready()
+  elapsed = time.perf_counter() - t0
+
+  windows_per_sec = n_iters * batch / elapsed
+  print(json.dumps({
+      'metric': 'model_forward_windows_per_sec',
+      'value': round(windows_per_sec, 1),
+      'unit': 'windows/s/chip (batch=1024, bf16)',
+      'vs_baseline': round(windows_per_sec / REFERENCE_WINDOWS_PER_SEC, 2),
+  }))
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,5 @@
+from deepconsensus_tpu.calibration.lib import (  # noqa: F401
+    QualityCalibrationValues,
+    calibrate_quality_scores,
+    parse_calibration_string,
+)

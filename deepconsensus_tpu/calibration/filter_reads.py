@@ -1,0 +1,44 @@
+"""Filter FASTQ/BAM reads by average phred quality
+(reference: deepconsensus/quality_calibration/filter_reads.py:68-140).
+"""
+from __future__ import annotations
+
+import logging
+
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.io import fastx
+from deepconsensus_tpu.utils import phred
+
+log = logging.getLogger(__name__)
+
+
+def filter_bam_or_fastq_by_quality(
+    input_path: str, output_path: str, min_quality: int
+) -> int:
+  """Writes reads with round(avg_phred) >= min_quality; returns count."""
+  kept = 0
+  total = 0
+  with fastx.FastqWriter(output_path) as out:
+    if input_path.endswith('.bam'):
+      with bam_lib.BamReader(input_path) as reader:
+        for rec in reader:
+          total += 1
+          if rec.quals is None:
+            continue
+          if round(phred.avg_phred(rec.quals), 5) >= min_quality:
+            out.write(
+                rec.qname,
+                rec.seq,
+                phred.quality_scores_to_string(rec.quals),
+            )
+            kept += 1
+    else:
+      for name, seq, qual in fastx.read_fastq(input_path):
+        total += 1
+        scores = phred.quality_string_to_array(qual)
+        if round(phred.avg_phred(scores), 5) >= min_quality:
+          out.write(name, seq, qual)
+          kept += 1
+  log.info('filter_reads: kept %d/%d reads at q>=%d', kept, total,
+           min_quality)
+  return kept

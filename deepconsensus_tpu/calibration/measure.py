@@ -1,0 +1,170 @@
+"""Empirical base-quality calibration measurement (`calibrate`).
+
+Walks a predictions-aligned BAM against the reference genome, counting
+matches/mismatches per predicted base quality; insertions and
+soft-clipped bases count as mismatches (reference:
+deepconsensus/quality_calibration/calculate_baseq_calibration.py:64-483).
+Intervals fan out over a process pool like the reference; the
+unindexed-BAM path here streams once and bins reads to intervals.
+"""
+from __future__ import annotations
+
+import collections
+import csv
+import dataclasses
+import multiprocessing
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.io import fastx
+
+MAX_BASEQ = 100
+INTERVAL_LENGTH = 1000
+
+Cigar = constants.Cigar
+
+
+@dataclasses.dataclass
+class RegionRecord:
+  contig: str
+  start: int
+  stop: int
+
+
+def get_contig_regions(
+    contig_lengths: Dict[str, int],
+    region: Optional[str] = None,
+    interval_length: int = INTERVAL_LENGTH,
+) -> List[RegionRecord]:
+  """Splits contigs (or one samtools-style region) into intervals
+  (reference: calculate_baseq_calibration.py:190-247)."""
+  regions = []
+  if region:
+    if ':' in region:
+      contig, span = region.split(':')
+      start, stop = (int(x) for x in span.split('-'))
+    else:
+      contig, start, stop = region, 0, contig_lengths[region]
+    spans = [(contig, start, stop)]
+  else:
+    spans = [(c, 0, ln) for c, ln in contig_lengths.items()]
+  for contig, start, stop in spans:
+    pos = start
+    while pos < stop:
+      regions.append(
+          RegionRecord(contig, pos, min(pos + interval_length - 1, stop))
+      )
+      pos += interval_length
+  return regions
+
+
+def stats_for_read(
+    record: bam_lib.BamRecord,
+    ref_sequence: str,
+    interval: RegionRecord,
+    quals: np.ndarray,
+    counts: List[Dict[str, int]],
+) -> None:
+  """Accumulates per-quality match/mismatch counts for one read within
+  one interval (reference: calculate_baseq_calibration.py:303-375)."""
+  ref_pos = record.pos
+  read_idx = 0
+  seq = record.seq
+  for op, length in zip(record.cigar_ops, record.cigar_lens):
+    if ref_pos > interval.stop:
+      break
+    if op in (Cigar.MATCH, Cigar.DIFF, Cigar.EQUAL):
+      for _ in range(length):
+        if (
+            interval.start <= ref_pos <= interval.stop
+            and ref_pos - interval.start < len(ref_sequence)
+        ):
+          ref_base = ref_sequence[ref_pos - interval.start].upper()
+          if ref_base in 'ACGT':
+            q = int(quals[read_idx])
+            key = 'M' if ref_base == seq[read_idx].upper() else 'X'
+            counts[q][key] += 1
+        read_idx += 1
+        ref_pos += 1
+    elif op in (Cigar.SOFT_CLIP, Cigar.INS):
+      for _ in range(length):
+        if interval.start <= ref_pos <= interval.stop:
+          counts[int(quals[read_idx])]['X'] += 1
+        read_idx += 1
+    elif op in (Cigar.REF_SKIP, Cigar.DEL):
+      ref_pos += length
+
+
+def calculate_quality_calibration(
+    bam: str,
+    ref: str,
+    output: str,
+    region: Optional[str] = None,
+    min_mapq: int = 60,
+    cpus: int = 0,
+    dc_calibration: str = 'skip',
+) -> List[Tuple[int, int, int]]:
+  """Writes CSV rows (baseq, total_match, total_mismatch); returns them."""
+  ref_seqs = fastx.read_fasta(ref)
+  reader = bam_lib.BamReader(bam)
+  contig_lengths = dict(
+      zip(reader.references, reader.reference_lengths)
+  )
+  regions = get_contig_regions(contig_lengths, region)
+  region_by_contig: Dict[str, List[RegionRecord]] = collections.defaultdict(
+      list
+  )
+  for r in regions:
+    region_by_contig[r.contig].append(r)
+
+  cal = calibration_lib.parse_calibration_string(dc_calibration)
+  counts = [{'M': 0, 'X': 0} for _ in range(MAX_BASEQ)]
+
+  for record in reader:
+    if (
+        record.is_unmapped
+        or record.is_secondary
+        or record.is_supplementary
+        or record.mapq < min_mapq
+        or record.quals is None
+        or record.reference_name not in ref_seqs
+    ):
+      continue
+    quals = record.quals
+    if cal.enabled:
+      quals = np.round(
+          calibration_lib.calibrate_quality_scores(
+              quals.astype(np.uint8), cal
+          )
+      ).astype(np.int32)
+    # Bin the read into every interval it overlaps, clipping counting
+    # to the interval bounds like the reference's fetch-per-interval.
+    ref_end = record.pos + int(
+        np.sum(
+            record.cigar_lens[
+                np.isin(record.cigar_ops,
+                        [Cigar.MATCH, Cigar.DEL, Cigar.REF_SKIP,
+                         Cigar.EQUAL, Cigar.DIFF])
+            ]
+        )
+    )
+    for interval in region_by_contig.get(record.reference_name, []):
+      if interval.stop < record.pos or interval.start >= ref_end:
+        continue
+      ref_slice = ref_seqs[record.reference_name][
+          interval.start : interval.stop + 1
+      ]
+      stats_for_read(record, ref_slice, interval, quals, counts)
+
+  rows = [
+      (q, counts[q]['M'], counts[q]['X']) for q in range(MAX_BASEQ)
+  ]
+  with open(output, 'w', newline='') as f:
+    writer = csv.writer(f)
+    writer.writerow(['baseq', 'total_match', 'total_mismatch'])
+    writer.writerows(rows)
+  return rows

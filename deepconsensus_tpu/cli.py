@@ -1,0 +1,206 @@
+"""Command-line interface: dctpu {preprocess,run,train,calibrate,filter_reads}.
+
+Mirrors the reference's subcommand surface (reference:
+deepconsensus/cli.py:50-118) with argparse.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _add_preprocess(sub):
+  p = sub.add_parser('preprocess', help='Generate examples from BAMs.')
+  p.add_argument('--subreads_to_ccs', required=True)
+  p.add_argument('--ccs_bam', required=True)
+  p.add_argument('--output', required=True,
+                 help="Output path; '@split' expands per split.")
+  p.add_argument('--max_passes', type=int, default=20)
+  p.add_argument('--example_width', type=int, default=100)
+  p.add_argument('--use_ccs_bq', action='store_true')
+  p.add_argument('--ins_trim', type=int, default=5)
+  p.add_argument('--use_ccs_smart_windows', action='store_true')
+  p.add_argument('--truth_bed')
+  p.add_argument('--truth_to_ccs')
+  p.add_argument('--truth_split')
+  p.add_argument('--limit', type=int, default=0)
+  p.add_argument('--cpus', type=int, default=0)
+
+
+def _add_run(sub):
+  p = sub.add_parser('run', help='Run inference: BAMs -> polished FASTQ.')
+  p.add_argument('--subreads_to_ccs', required=True)
+  p.add_argument('--ccs_bam', required=True)
+  p.add_argument('--checkpoint', required=True)
+  p.add_argument('--output', required=True)
+  p.add_argument('--batch_size', type=int, default=1024)
+  p.add_argument('--batch_zmws', type=int, default=100)
+  p.add_argument('--min_length', type=int, default=0)
+  p.add_argument('--min_quality', type=int, default=20)
+  p.add_argument('--skip_windows_above', type=int, default=45)
+  p.add_argument('--ins_trim', type=int, default=5)
+  p.add_argument('--use_ccs_smart_windows', action='store_true')
+  p.add_argument('--max_base_quality', type=int, default=93)
+  p.add_argument('--dc_calibration', default=None)
+  p.add_argument('--ccs_calibration', default='skip')
+  p.add_argument('--limit', type=int, default=0)
+
+
+def _add_train(sub):
+  p = sub.add_parser('train', help='Train a model.')
+  p.add_argument('--config', default='transformer_learn_values+test',
+                 help='{model}+{dataset} preset name.')
+  p.add_argument('--out_dir', required=True)
+  p.add_argument('--train_path', nargs='*')
+  p.add_argument('--eval_path', nargs='*')
+  p.add_argument('--num_epochs', type=int)
+  p.add_argument('--batch_size', type=int)
+  p.add_argument('--checkpoint', help='Warm-start checkpoint.')
+  p.add_argument('--tp', type=int, default=1,
+                 help='Tensor-parallel mesh size.')
+
+
+def _add_calibrate(sub):
+  p = sub.add_parser(
+      'calibrate', help='Measure empirical base-quality calibration.')
+  p.add_argument('--bam', required=True,
+                 help='Predictions aligned to the reference genome.')
+  p.add_argument('--ref', required=True, help='Reference FASTA.')
+  p.add_argument('--output', required=True, help='Output CSV.')
+  p.add_argument('--region')
+  p.add_argument('--cpus', type=int, default=0)
+
+
+def _add_filter_reads(sub):
+  p = sub.add_parser('filter_reads', help='Filter reads by avg quality.')
+  p.add_argument('--input', required=True, help='FASTQ or BAM input.')
+  p.add_argument('--output', required=True, help='FASTQ output (.gz ok).')
+  p.add_argument('--quality', type=int, required=True)
+
+
+def build_parser() -> argparse.ArgumentParser:
+  parser = argparse.ArgumentParser(
+      prog='dctpu',
+      description='DeepConsensus-TPU: TPU-native CCS polishing.',
+  )
+  sub = parser.add_subparsers(dest='command', required=True)
+  _add_preprocess(sub)
+  _add_run(sub)
+  _add_train(sub)
+  _add_calibrate(sub)
+  _add_filter_reads(sub)
+  return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  args = build_parser().parse_args(argv)
+
+  if args.command == 'preprocess':
+    from deepconsensus_tpu.preprocess.driver import run_preprocess
+
+    run_preprocess(
+        subreads_to_ccs=args.subreads_to_ccs,
+        ccs_bam=args.ccs_bam,
+        output=args.output,
+        max_passes=args.max_passes,
+        example_width=args.example_width,
+        use_ccs_bq=args.use_ccs_bq,
+        ins_trim=args.ins_trim,
+        use_ccs_smart_windows=args.use_ccs_smart_windows,
+        truth_bed=args.truth_bed,
+        truth_to_ccs=args.truth_to_ccs,
+        truth_split=args.truth_split,
+        limit=args.limit,
+        cpus=args.cpus,
+    )
+    return 0
+
+  if args.command == 'run':
+    from deepconsensus_tpu.calibration import lib as calibration_lib
+    from deepconsensus_tpu.inference import runner as runner_lib
+    from deepconsensus_tpu.models import config as config_lib
+
+    dc_cal = args.dc_calibration
+    if dc_cal is None:
+      params = config_lib.read_params_from_json(args.checkpoint)
+      dc_cal = params.get('dc_calibration', 'skip') or 'skip'
+    options = runner_lib.InferenceOptions(
+        batch_size=args.batch_size,
+        batch_zmws=args.batch_zmws,
+        min_length=args.min_length,
+        min_quality=args.min_quality,
+        skip_windows_above=args.skip_windows_above,
+        ins_trim=args.ins_trim,
+        use_ccs_smart_windows=args.use_ccs_smart_windows,
+        max_base_quality=args.max_base_quality,
+        limit=args.limit,
+        dc_calibration_values=calibration_lib.parse_calibration_string(
+            dc_cal
+        ),
+        ccs_calibration_values=calibration_lib.parse_calibration_string(
+            args.ccs_calibration
+        ),
+    )
+    counters = runner_lib.run_inference(
+        subreads_to_ccs=args.subreads_to_ccs,
+        ccs_bam=args.ccs_bam,
+        checkpoint=args.checkpoint,
+        output=args.output,
+        options=options,
+    )
+    return 0 if counters.get('success', 0) > 0 else 1
+
+  if args.command == 'train':
+    from deepconsensus_tpu.models import config as config_lib
+    from deepconsensus_tpu.models import train as train_lib
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    params = config_lib.get_config(args.config)
+    config_lib.finalize_params(params)
+    with params.unlocked():
+      if args.batch_size:
+        params.batch_size = args.batch_size
+    mesh = mesh_lib.make_mesh(tp=args.tp)
+    train_lib.run_training(
+        params=params,
+        out_dir=args.out_dir,
+        train_patterns=args.train_path,
+        eval_patterns=args.eval_path,
+        num_epochs=args.num_epochs,
+        mesh=mesh,
+        warm_start=args.checkpoint,
+    )
+    return 0
+
+  if args.command == 'calibrate':
+    from deepconsensus_tpu.calibration.measure import (
+        calculate_quality_calibration,
+    )
+
+    calculate_quality_calibration(
+        bam=args.bam,
+        ref=args.ref,
+        output=args.output,
+        region=args.region,
+        cpus=args.cpus,
+    )
+    return 0
+
+  if args.command == 'filter_reads':
+    from deepconsensus_tpu.calibration.filter_reads import (
+        filter_bam_or_fastq_by_quality,
+    )
+
+    filter_bam_or_fastq_by_quality(
+        input_path=args.input,
+        output_path=args.output,
+        min_quality=args.quality,
+    )
+    return 0
+
+  return 2
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,5 @@
+from deepconsensus_tpu.inference.runner import (  # noqa: F401
+    InferenceOptions,
+    ModelRunner,
+    run_inference,
+)

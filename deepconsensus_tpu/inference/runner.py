@@ -1,0 +1,346 @@
+"""Batched inference: BAM -> windows -> jitted model -> stitched FASTQ.
+
+TPU-native re-design of the reference's quick_inference driver
+(reference: deepconsensus/inference/quick_inference.py:68-984):
+
+* Featurization runs the vectorized preprocess core (no per-base Python
+  loops), so the host keeps up with the accelerator without a process
+  pool for moderate workloads; a pool can still fan it out.
+* The model step is one jitted function over fixed-shape batches
+  (padded final batch) returning argmax bases and max probabilities,
+  so only two small arrays cross the device boundary per batch.
+* Window skip triage (CCS quality above threshold, overflow windows)
+  happens on host exactly like the reference, including CCS-quality
+  calibration of skipped windows.
+* Per-stage wall-time is recorded and dumped to <output>.runtime.csv.
+"""
+from __future__ import annotations
+
+import collections
+import csv
+import dataclasses
+import itertools
+import json
+import logging
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import data as data_lib
+from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.postprocess import stitch
+from deepconsensus_tpu.preprocess import (
+    FeatureLayout,
+    create_proc_feeder,
+    reads_to_pileup,
+)
+from deepconsensus_tpu.preprocess.pileup import row_indices
+from deepconsensus_tpu.utils import phred
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class InferenceOptions:
+  """Knobs shared across inference stages
+  (reference: quick_inference.py:243-275)."""
+
+  max_length: int = 100
+  max_passes: int = 20
+  min_quality: int = 20
+  min_length: int = 0
+  batch_size: int = 1024
+  batch_zmws: int = 100
+  use_ccs_bq: bool = False
+  skip_windows_above: int = 45
+  ins_trim: int = 5
+  use_ccs_smart_windows: bool = False
+  max_base_quality: int = 93
+  limit: int = 0
+  dc_calibration_values: calibration_lib.QualityCalibrationValues = (
+      dataclasses.field(
+          default_factory=lambda: calibration_lib.parse_calibration_string(
+              'skip'
+          )
+      )
+  )
+  ccs_calibration_values: calibration_lib.QualityCalibrationValues = (
+      dataclasses.field(
+          default_factory=lambda: calibration_lib.parse_calibration_string(
+              'skip'
+          )
+      )
+  )
+
+
+class ModelRunner:
+  """Jitted forward pass producing (bases, quality scores) per window."""
+
+  def __init__(self, params, variables, options: InferenceOptions):
+    self.params = params
+    self.variables = variables
+    self.options = options
+    model = model_lib.get_model(params)
+
+    def forward(variables, rows):
+      preds = model.apply(variables, rows)
+      pred_ids = jnp.argmax(preds, axis=-1).astype(jnp.int32)
+      max_prob = jnp.max(preds, axis=-1)
+      return pred_ids, max_prob
+
+    self._forward = jax.jit(forward)
+
+  @classmethod
+  def from_checkpoint(cls, checkpoint_path: str,
+                      options: InferenceOptions) -> 'ModelRunner':
+    import orbax.checkpoint as ocp
+    import os
+
+    params = config_lib.read_params_from_json(checkpoint_path)
+    config_lib.finalize_params(params, is_training=False)
+    model = model_lib.get_model(params)
+    rows = jnp.zeros(
+        (1, params.total_rows, params.max_length, 1), jnp.float32
+    )
+    variables = model.init(jax.random.PRNGKey(0), rows)
+    checkpointer = ocp.StandardCheckpointer()
+    restored = checkpointer.restore(
+        os.path.abspath(checkpoint_path),
+        target={'params': jax.device_get(variables['params']), 'step': 0},
+    )
+    return cls(params, {'params': restored['params']}, options)
+
+  def predict(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """rows [B, R, L, 1] -> (base ids [B, L], quality scores [B, L])."""
+    n = rows.shape[0]
+    batch = self.options.batch_size
+    if n < batch:  # pad to the fixed compiled shape
+      pad = np.zeros((batch - n,) + rows.shape[1:], rows.dtype)
+      rows = np.concatenate([rows, pad])
+    pred_ids, max_prob = self._forward(self.variables, jnp.asarray(rows))
+    pred_ids = np.asarray(pred_ids[:n])
+    max_prob = np.asarray(max_prob[:n])
+    error_prob = np.maximum(1.0 - max_prob, 1e-12)
+    quality = -10.0 * np.log10(error_prob)
+    opts = self.options
+    if opts.dc_calibration_values.enabled:
+      quality = calibration_lib.calibrate_quality_scores(
+          quality, opts.dc_calibration_values
+      )
+    quality = np.minimum(quality, opts.max_base_quality)
+    quality = np.round(quality, decimals=0).astype(np.int32)
+    quality = np.maximum(quality, 0)
+    return pred_ids, quality
+
+
+def preprocess_zmw(
+    zmw_input, options: InferenceOptions
+) -> Tuple[List[Dict[str, Any]], collections.Counter]:
+  """One ZMW -> list of window feature dicts
+  (reference: quick_inference.py:535-564)."""
+  subreads, name, layout, _split, window_widths = zmw_input
+  pileup = reads_to_pileup(subreads, name, layout, window_widths)
+  features = [w.to_features_dict() for w in pileup.iter_windows()]
+  return features, pileup.counter
+
+
+def process_skipped_window(
+    feature_dict: Dict[str, Any], options: InferenceOptions
+) -> stitch.DCModelOutput:
+  """Adopts the CCS bases/qualities for a skipped window
+  (reference: quick_inference.py:567-594)."""
+  rows = feature_dict['subreads']
+  ccs_range = row_indices(options.max_passes, options.use_ccs_bq)[4]
+  ccs = rows[ccs_range[0], :, 0]
+  ccs_seq = phred.encoded_sequence_to_string(ccs)
+  quals = np.asarray(feature_dict['ccs_base_quality_scores'])
+  if options.ccs_calibration_values.enabled:
+    quals = calibration_lib.calibrate_quality_scores(
+        quals, options.ccs_calibration_values
+    )
+  quals = np.minimum(quals, options.max_base_quality).astype(np.int32)
+  return stitch.DCModelOutput(
+      window_pos=feature_dict['window_pos'],
+      molecule_name=feature_dict['name'],
+      sequence=ccs_seq,
+      quality_string=phred.quality_scores_to_string(np.maximum(quals, 0)),
+      ec=feature_dict['ec'],
+      np_num_passes=feature_dict['np_num_passes'],
+      rq=feature_dict['rq'],
+      rg=feature_dict['rg'],
+  )
+
+
+def _triage_windows(
+    feature_dicts: List[Dict[str, Any]],
+    options: InferenceOptions,
+    counter: collections.Counter,
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+  """Splits windows into (model, skip) per overflow/quality rules
+  (reference: quick_inference.py:653-678)."""
+  to_model: List[Dict[str, Any]] = []
+  to_skip: List[Dict[str, Any]] = []
+  for fd in feature_dicts:
+    if fd['overflow']:
+      to_skip.append(fd)
+      counter['n_windows_overflow_skipped'] += 1
+      continue
+    if options.skip_windows_above:
+      avg_q = phred.avg_phred(fd['ccs_base_quality_scores'])
+      if avg_q >= options.skip_windows_above:
+        to_skip.append(fd)
+        counter['n_windows_quality_skipped'] += 1
+        continue
+    to_model.append(fd)
+    counter['n_windows_to_model'] += 1
+  return to_model, to_skip
+
+
+def run_model_on_windows(
+    feature_dicts: List[Dict[str, Any]],
+    runner: ModelRunner,
+    params,
+    options: InferenceOptions,
+) -> List[stitch.DCModelOutput]:
+  """Formats, batches, and runs windows through the model
+  (reference: quick_inference.py:341-415)."""
+  outputs: List[stitch.DCModelOutput] = []
+  processed = [
+      data_lib.process_feature_dict(fd, params) for fd in feature_dicts
+  ]
+  for start in range(0, len(processed), options.batch_size):
+    chunk = processed[start : start + options.batch_size]
+    rows = np.stack([c['rows'] for c in chunk])
+    pred_ids, quality = runner.predict(rows)
+    for c, ids, quals in zip(chunk, pred_ids, quality):
+      outputs.append(
+          stitch.DCModelOutput(
+              window_pos=c['window_pos'],
+              molecule_name=c['name'] if isinstance(c['name'], str)
+              else c['name'].decode(),
+              sequence=phred.encoded_sequence_to_string(ids),
+              quality_string=phred.quality_scores_to_string(quals),
+              ec=c['ec'],
+              np_num_passes=c['np_num_passes'],
+              rq=c['rq'],
+              rg=c['rg'],
+          )
+      )
+  return outputs
+
+
+def run_inference(
+    subreads_to_ccs: str,
+    ccs_bam: str,
+    checkpoint: Optional[str],
+    output: str,
+    options: Optional[InferenceOptions] = None,
+    runner: Optional[ModelRunner] = None,
+) -> Dict[str, Any]:
+  """Full inference pipeline; returns the counters dict
+  (reference run(): quick_inference.py:794-963)."""
+  options = options or InferenceOptions()
+  if runner is None:
+    if checkpoint is None:
+      raise ValueError('need checkpoint or runner')
+    runner = ModelRunner.from_checkpoint(checkpoint, options)
+  params = runner.params
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+
+  layout = FeatureLayout(
+      max_passes=options.max_passes,
+      max_length=options.max_length,
+      use_ccs_bq=options.use_ccs_bq,
+  )
+  feeder, counter = create_proc_feeder(
+      subreads_to_ccs=subreads_to_ccs,
+      ccs_bam=ccs_bam,
+      layout=layout,
+      ins_trim=options.ins_trim,
+      use_ccs_smart_windows=options.use_ccs_smart_windows,
+      limit=options.limit,
+  )
+  outcome = stitch.OutcomeCounter()
+  timing_rows: List[Dict[str, Any]] = []
+  fastq_lines = 0
+
+  with open(output, 'w') as out_f:
+
+    def flush_zmw_batch(zmw_batch):
+      nonlocal fastq_lines
+      if not zmw_batch:
+        return
+      t0 = time.time()
+      all_windows: List[Dict[str, Any]] = []
+      n_subreads = 0
+      for zmw_input in zmw_batch:
+        n_subreads += len(zmw_input[0]) - 1
+        features, zmw_counter = preprocess_zmw(zmw_input, options)
+        counter.update(zmw_counter)
+        all_windows.extend(features)
+      t1 = time.time()
+      to_model, to_skip = _triage_windows(all_windows, options, counter)
+      predictions = [
+          process_skipped_window(fd, options) for fd in to_skip
+      ]
+      predictions.extend(
+          run_model_on_windows(to_model, runner, params, options)
+      )
+      t2 = time.time()
+      predictions.sort(key=lambda p: (p.molecule_name, p.window_pos))
+      for name, group in itertools.groupby(
+          predictions, key=lambda p: p.molecule_name
+      ):
+        fastq = stitch.stitch_to_fastq(
+            molecule_name=name,
+            predictions=group,
+            max_length=options.max_length,
+            min_quality=options.min_quality,
+            min_length=options.min_length,
+            outcome_counter=outcome,
+        )
+        if fastq is not None:
+          out_f.write(fastq)
+          fastq_lines += 1
+      t3 = time.time()
+      timing_rows.extend([
+          dict(stage='preprocess', runtime=t1 - t0, n_zmws=len(zmw_batch),
+               n_examples=len(all_windows), n_subreads=n_subreads),
+          dict(stage='run_model', runtime=t2 - t1, n_zmws=len(zmw_batch),
+               n_examples=len(all_windows), n_subreads=n_subreads),
+          dict(stage='stitch_and_write_fastq', runtime=t3 - t2,
+               n_zmws=len(zmw_batch), n_examples=len(all_windows),
+               n_subreads=n_subreads),
+      ])
+
+    zmw_batch = []
+    for zmw_input in feeder():
+      zmw_batch.append(zmw_input)
+      if options.batch_zmws and len(zmw_batch) >= options.batch_zmws:
+        flush_zmw_batch(zmw_batch)
+        zmw_batch = []
+    flush_zmw_batch(zmw_batch)
+
+  # Sidecar outputs (reference: quick_inference.py:777-791,961-962).
+  with open(output + '.runtime.csv', 'w', newline='') as f:
+    writer = csv.DictWriter(
+        f, fieldnames=['stage', 'runtime', 'n_zmws', 'n_examples',
+                       'n_subreads']
+    )
+    writer.writeheader()
+    writer.writerows(timing_rows)
+  counters = dict(counter)
+  counters.update(dataclasses.asdict(outcome))
+  with open(output + '.inference.json', 'w') as f:
+    json.dump(counters, f, indent=2, sort_keys=True)
+  if not outcome.success:
+    log.warning('No reads passed filters; outcome=%s', outcome)
+  return counters

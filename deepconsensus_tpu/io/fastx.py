@@ -1,0 +1,61 @@
+"""Minimal FASTA/FASTQ reading and FASTQ writing (gzip-aware)."""
+from __future__ import annotations
+
+import gzip
+from typing import Dict, Iterator, Optional, Tuple
+
+
+def _open(path: str, mode: str = 'rt'):
+  if path.endswith('.gz'):
+    return gzip.open(path, mode)
+  return open(path, mode)
+
+
+def read_fasta(path: str) -> Dict[str, str]:
+  """Loads a FASTA file into {name: sequence}."""
+  seqs: Dict[str, str] = {}
+  name = None
+  parts = []
+  with _open(path) as f:
+    for line in f:
+      line = line.rstrip('\n')
+      if line.startswith('>'):
+        if name is not None:
+          seqs[name] = ''.join(parts)
+        name = line[1:].split()[0]
+        parts = []
+      else:
+        parts.append(line)
+  if name is not None:
+    seqs[name] = ''.join(parts)
+  return seqs
+
+
+def read_fastq(path: str) -> Iterator[Tuple[str, str, str]]:
+  """Yields (name, sequence, quality_string)."""
+  with _open(path) as f:
+    while True:
+      header = f.readline()
+      if not header:
+        return
+      seq = f.readline().rstrip('\n')
+      f.readline()  # '+'
+      qual = f.readline().rstrip('\n')
+      yield header.rstrip('\n')[1:], seq, qual
+
+
+class FastqWriter:
+  def __init__(self, path: str):
+    self._f = _open(path, 'wt')
+
+  def write(self, name: str, sequence: str, quality_string: str) -> None:
+    self._f.write(f'@{name}\n{sequence}\n+\n{quality_string}\n')
+
+  def close(self) -> None:
+    self._f.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()

@@ -1,0 +1,302 @@
+"""Alignment metric (approximate pbmm2 identity) and accuracy metrics.
+
+AlignmentMetric runs a Needleman-Wunsch alignment with affine gaps
+(scores A=2, B=5, o=5, e=4 approximating pbmm2) as a wavefront scan with
+three states (M/I/D), records per-antidiagonal argmax directions, then
+backtracks to per-example match/insertion/deletion counts and percent
+identity (reference: deepconsensus/models/losses_and_metrics.py:
+666-1111). Both recursions are lax.scans and run on device.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.models.losses import left_shift_sequence
+from deepconsensus_tpu.ops import wavefront
+
+Array = jnp.ndarray
+
+
+def _preprocess_true(y_true: Array) -> Tuple[Array, Array]:
+  y_true = left_shift_sequence(y_true.astype(jnp.int32))
+  lens = jnp.sum((y_true != constants.GAP_INT).astype(jnp.int32), -1)
+  return y_true, lens
+
+
+def _preprocess_pred(y_pred_scores: Array) -> Tuple[Array, Array]:
+  y_pred = jnp.argmax(y_pred_scores, axis=-1).astype(jnp.int32)
+  y_pred = left_shift_sequence(y_pred)
+  lens = jnp.sum((y_pred != constants.GAP_INT).astype(jnp.int32), -1)
+  return y_pred, lens
+
+
+class AlignmentMetric:
+  """NW affine-gap alignment + identity metrics."""
+
+  def __init__(
+      self,
+      matching_score: float = 2.0,
+      mismatch_penalty: float = 5.0,
+      gap_open_penalty: float = 5.0,
+      gap_extend_penalty: float = 4.0,
+  ):
+    self.matching_score = matching_score
+    self.mismatch_penalty = mismatch_penalty
+    # pbmm2 charges o + k*e; the DP uses o + (k-1)*e, so fold one extend
+    # into the open (reference: losses_and_metrics.py:698-701).
+    self.gap_open_penalty = gap_open_penalty + gap_extend_penalty
+    self.gap_extend_penalty = gap_extend_penalty
+
+  def alignment(
+      self, y_true: Array, y_pred_scores: Array
+  ) -> Tuple[Array, Array, Dict[str, Array]]:
+    """Returns (v_opt [B], paths [B, m+1, n+1], metric dict)."""
+    dtype = jnp.float32
+    inf = jnp.asarray(1e9, dtype)
+    b, m = y_true.shape
+    n = y_pred_scores.shape[1]
+
+    y_true, y_true_lens = _preprocess_true(y_true)
+    y_pred, y_pred_lens = _preprocess_pred(y_pred_scores)
+
+    subs_costs = jnp.where(
+        y_true[:, :, None] == y_pred[:, None, :],
+        jnp.asarray(self.matching_score, dtype),
+        jnp.asarray(-self.mismatch_penalty, dtype),
+    )  # [B, m, n]
+    subs_w = wavefront.wavefrontify(subs_costs)  # [m+n-1, B, m]
+
+    go = jnp.asarray(self.gap_open_penalty, dtype)
+    ge = jnp.asarray(self.gap_extend_penalty, dtype)
+
+    i_range = jnp.arange(m + 1)
+    k_end = y_true_lens + y_pred_lens
+    samp = jnp.arange(b)
+
+    # ---- init (k=0, k=1) --------------------------------------------
+    # v_all_*: [B, 3, *] for states (M, I, D).
+    v_all_p2 = jnp.full((b, 3, m), -inf).at[:, 0, 0].set(0.0)
+    v_all_p1 = jnp.full((b, 3, m + 1), -inf)
+    v_all_p1 = v_all_p1.at[:, 1, 0].set(-go)
+    v_all_p1 = v_all_p1.at[:, 2, 1].set(-go)
+
+    dir0 = jnp.full((b, 3, m + 1), -2, jnp.int8).at[:, 0, 0].set(-1)
+    dir1 = jnp.full((b, 3, m + 1), -2, jnp.int8)
+    dir1 = dir1.at[:, 1, 0].set(0)
+    dir1 = dir1.at[:, 2, 1].set(0)
+
+    def argmax_over_states(v):  # v: [B, 3, X]
+      return jnp.max(v, axis=1), jnp.argmax(v, axis=1).astype(jnp.int8)
+
+    def maybe_update(k, v_opt, m_opt, v_all_p1):
+      v_k, m_k = argmax_over_states(v_all_p1)  # [B, m+1]
+      v_at = jnp.take_along_axis(v_k, y_true_lens[:, None], 1)[:, 0]
+      m_at = jnp.take_along_axis(m_k, y_true_lens[:, None], 1)[:, 0]
+      cond = k_end == k
+      return (
+          jnp.where(cond, v_at, v_opt),
+          jnp.where(cond, m_at.astype(jnp.int32), m_opt),
+      )
+
+    v_opt = jnp.zeros((b,), dtype)
+    m_opt = jnp.full((b,), -1, jnp.int32)
+    v_opt, m_opt = maybe_update(1, v_opt, m_opt, v_all_p1)
+
+    ks = jnp.arange(2, m + n + 1)
+
+    def fwd_step(carry, xs):
+      v_all_p2, v_all_p1, v_opt, m_opt = carry
+      k, subs_k = xs
+      j_range = k - i_range
+      valid = (j_range >= 0) & (j_range <= n)  # [m+1]
+
+      o_match = v_all_p2 + subs_k[:, None, :]  # [B, 3, m]
+      o_ins = v_all_p1[:, :2] - jnp.stack([go, ge])[None, :, None]
+      v_all_p2_next = v_all_p1[:, :, :-1]
+      o_del = v_all_p2_next - jnp.stack([go, go, ge])[None, :, None]
+
+      v_match, dir_match = argmax_over_states(o_match)  # [B, m]
+      v_ins, dir_ins = argmax_over_states(o_ins)  # [B, m+1]
+      v_del, dir_del = argmax_over_states(o_del)  # [B, m]
+
+      pad_val = jnp.full((b, 1), -inf)
+      pad_dir = jnp.full((b, 1), -2, jnp.int8)
+      v_match = jnp.concatenate([pad_val, v_match], axis=1)
+      v_del = jnp.concatenate([pad_val, v_del], axis=1)
+      dir_match = jnp.concatenate([pad_dir, dir_match], axis=1)
+      dir_del = jnp.concatenate([pad_dir, dir_del], axis=1)
+
+      v_new = jnp.where(
+          valid[None, None, :],
+          jnp.stack([v_match, v_ins, v_del], axis=1),
+          -inf,
+      )
+      dirs = jnp.stack([dir_match, dir_ins, dir_del], axis=1)
+      v_opt, m_opt = maybe_update(k, v_opt, m_opt, v_new)
+      return (v_all_p2_next, v_new, v_opt, m_opt), dirs
+
+    (_, _, v_opt, m_opt), dir_rows = jax.lax.scan(
+        fwd_step, (v_all_p2, v_all_p1, v_opt, m_opt), (ks, subs_w)
+    )
+    # dir_all[k] for k = 0..m+n.
+    dir_all = jnp.concatenate([dir0[None], dir1[None], dir_rows], axis=0)
+
+    # ---- backtracking ------------------------------------------------
+    steps_k = jnp.asarray([-2, -1, -1], jnp.int32)
+    steps_i = jnp.asarray([-1, 0, -1], jnp.int32)
+    trans_enc = jnp.asarray(
+        [[1, 1, 1], [2, 3, 2], [4, 4, 5]], jnp.int32
+    )  # [state_curr, state_prev] -> edge id
+
+    def bwd_step(carry, xs):
+      k, dirs_k = xs  # dirs_k: [B, 3, m+1]
+      k_opt, i_opt, m_opt = carry
+      safe_m = jnp.maximum(m_opt, 0)
+      safe_i = jnp.maximum(i_opt, 0)
+      k_opt_n = k_opt + steps_k[safe_m]
+      i_opt_n = i_opt + steps_i[safe_m]
+      m_opt_n = dirs_k[samp, safe_m, safe_i].astype(jnp.int32)
+      safe_m_n = jnp.maximum(m_opt_n, 0)
+      edges_n = trans_enc[safe_m, safe_m_n]
+      reached_start = m_opt_n == -1
+      cond = (k_opt == k) & ~reached_start
+      paths_row = jnp.where(
+          cond[:, None],
+          jnp.stack([samp, i_opt, k_opt - i_opt, edges_n], axis=-1),
+          jnp.zeros((b, 4), jnp.int32),
+      )
+      k_opt = jnp.where(cond, k_opt_n, k_opt)
+      i_opt = jnp.where(cond, i_opt_n, i_opt)
+      m_opt = jnp.where(cond, m_opt_n, m_opt)
+      return (k_opt, i_opt, m_opt), paths_row
+
+    ks_rev = jnp.arange(m + n, -1, -1)
+    (_, _, _), path_rows = jax.lax.scan(
+        bwd_step, (k_end, y_true_lens, m_opt), (ks_rev, dir_all[ks_rev])
+    )
+    paths_sp = path_rows.reshape(-1, 4)
+    paths = jnp.zeros((b, m + 1, n + 1), jnp.int32).at[
+        paths_sp[:, 0], paths_sp[:, 1], paths_sp[:, 2]
+    ].add(paths_sp[:, 3])
+
+    # ---- metrics -----------------------------------------------------
+    matches_mask = paths == 1
+    ins_mask = (paths == 2) | (paths == 3)
+    del_mask = (paths == 4) | (paths == 5)
+    correct = matches_mask[:, 1:, 1:] & (subs_costs > 0)
+
+    def count(t):
+      return jnp.sum(t.astype(jnp.int32), axis=(1, 2))
+
+    metric_values = {
+        'num_matches': count(matches_mask),
+        'num_insertions': count(ins_mask),
+        'num_deletions': count(del_mask),
+        'num_correct_matches': count(correct),
+    }
+    metric_values['alignment_length'] = (
+        metric_values['num_matches']
+        + metric_values['num_insertions']
+        + metric_values['num_deletions']
+    )
+    unsafe_pid = metric_values['num_correct_matches'] / jnp.maximum(
+        metric_values['alignment_length'], 1
+    )
+    metric_values['pid'] = jnp.where(
+        metric_values['alignment_length'] > 0,
+        unsafe_pid.astype(dtype),
+        jnp.asarray(1.0, dtype),
+    )
+    return v_opt, paths, metric_values
+
+
+def per_batch_identity(metric_values: Dict[str, Array]) -> Array:
+  """Batch-pooled identity (reference: losses_and_metrics.py:1101-1111)."""
+  total = jnp.sum(metric_values['alignment_length'])
+  pid = jnp.sum(metric_values['num_correct_matches']) / jnp.maximum(total, 1)
+  return jnp.where(total > 0, pid.astype(jnp.float32), 1.0)
+
+
+def batch_identity_ccs_pred(
+    ccs: Array,
+    y_pred_scores: Array,
+    y_true: Array,
+    alignment_metric: AlignmentMetric,
+) -> Tuple[Array, Array]:
+  """Identity of CCS and of the prediction vs truth
+  (reference: losses_and_metrics.py:1061-1098)."""
+  _, _, mv_pred = alignment_metric.alignment(y_true, y_pred_scores)
+  ccs_oh = jax.nn.one_hot(
+      ccs.astype(jnp.int32), constants.SEQ_VOCAB_SIZE, dtype=jnp.float32
+  )
+  _, _, mv_ccs = alignment_metric.alignment(y_true, ccs_oh)
+  return per_batch_identity(mv_ccs), per_batch_identity(mv_pred)
+
+
+def per_example_accuracy_counts(
+    y_true: Array, y_pred_scores: Array
+) -> Tuple[Array, Array]:
+  """(correct_examples, total_examples) after left-shifting both
+  (reference PerExampleAccuracy: losses_and_metrics.py:37-65)."""
+  y_true = left_shift_sequence(y_true.astype(jnp.int32))
+  y_pred = left_shift_sequence(
+      jnp.argmax(y_pred_scores, axis=-1).astype(jnp.int32)
+  )
+  row_correct = jnp.all(y_true == y_pred, axis=-1)
+  return jnp.sum(row_correct.astype(jnp.int32)), y_true.shape[0]
+
+
+def per_class_accuracy_counts(
+    y_true: Array, y_pred_scores: Array, class_value: int
+) -> Tuple[Array, Array]:
+  """(correct, total) over positions whose label is class_value
+  (reference PerClassAccuracy: losses_and_metrics.py:68-89)."""
+  y_pred = jnp.argmax(y_pred_scores, axis=-1).astype(jnp.int32)
+  mask = y_true.astype(jnp.int32) == class_value
+  correct = (y_pred == y_true.astype(jnp.int32)) & mask
+  return jnp.sum(correct.astype(jnp.int32)), jnp.sum(mask.astype(jnp.int32))
+
+
+@dataclasses.dataclass
+class Mean:
+  """Tiny streaming mean accumulator (host side)."""
+
+  total: float = 0.0
+  count: float = 0.0
+
+  def update(self, value, weight=1.0):
+    self.total += float(value) * float(weight)
+    self.count += float(weight)
+
+  def result(self) -> float:
+    return self.total / self.count if self.count else 0.0
+
+  def reset(self):
+    self.total = 0.0
+    self.count = 0.0
+
+
+@dataclasses.dataclass
+class YieldOverCCS:
+  """Batches where identity >= threshold, DC vs CCS
+  (reference YieldOverCCSMetric: losses_and_metrics.py:1114-1167)."""
+
+  quality_threshold: float = 0.997
+  yield_dc: float = 0.0
+  yield_ccs: float = 0.0
+
+  def update(self, identity_ccs: float, identity_pred: float):
+    self.yield_dc += float(identity_pred >= self.quality_threshold)
+    self.yield_ccs += float(identity_ccs >= self.quality_threshold)
+
+  def result(self) -> float:
+    return self.yield_dc / self.yield_ccs if self.yield_ccs else 0.0
+
+  def reset(self):
+    self.yield_dc = 0.0
+    self.yield_ccs = 0.0

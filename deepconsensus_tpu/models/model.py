@@ -60,7 +60,9 @@ class MaskedEmbed(nn.Module):
         (self.vocab_size, self.features),
         jnp.float32,
     )
-    emb = jnp.take(table.astype(self.dtype), ids, axis=0)
+    # clip mode: out-of-range ids (already clipped upstream by
+    # format_rows) clamp instead of producing NaN fill values.
+    emb = jnp.take(table.astype(self.dtype), ids, axis=0, mode='clip')
     emb = emb * jnp.asarray(self.features**0.5, self.dtype)
     mask = (ids != 0).astype(self.dtype)
     return emb * mask[..., None]

@@ -1,0 +1,4 @@
+from deepconsensus_tpu.ops.wavefront import (  # noqa: F401
+    wavefrontify,
+    wavefrontify_vec,
+)

@@ -1,0 +1,211 @@
+"""Anti-diagonal (wavefront) dynamic programming primitives.
+
+The alignment loss and metric both run edit-distance-style DPs. On TPU
+the natural formulation is a `lax.scan` over anti-diagonals: each scan
+step updates a full diagonal vector at once, so the DP parallelizes
+across the batch and the diagonal dimension with static shapes
+(reference formulation: deepconsensus/models/losses_and_metrics.py:
+210-260,346-411; here re-expressed with gather-based wavefrontification
+and scan instead of Python-level tf loops).
+
+Conventions: y_true has length m (padded), y_pred length n; DP matrices
+are [m+1, n+1]; anti-diagonal k holds cells (i, k-i).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def wavefrontify(t: Array) -> Array:
+  """[B, m, n] -> [m+n-1, B, m] with out[k, b, i] = t[b, i, k-i].
+
+  Out-of-range entries are 0.
+  """
+  b, m, n = t.shape
+  k = jnp.arange(m + n - 1)
+  i = jnp.arange(m)
+  j = k[:, None] - i[None, :]  # [K, m]
+  valid = (j >= 0) & (j < n)
+  jc = jnp.clip(j, 0, n - 1)
+  # gathered[b, k, i] = t[b, i, jc[k, i]]
+  gathered = t[:, i[None, :], jc]  # [B, K, m]
+  gathered = jnp.where(valid[None], gathered, 0)
+  return jnp.transpose(gathered, (1, 0, 2))
+
+
+def wavefrontify_vec(v: Array, len1: int) -> Array:
+  """[B, n] -> [len1+n-1, B, len1] with out[k, b, i] = v[b, k-i]."""
+  b, n = v.shape
+  k = jnp.arange(len1 + n - 1)
+  i = jnp.arange(len1)
+  j = k[:, None] - i[None, :]
+  valid = (j >= 0) & (j < n)
+  jc = jnp.clip(j, 0, n - 1)
+  gathered = v[:, jc]  # [B, K, len1]
+  gathered = jnp.where(valid[None], gathered, 0)
+  return jnp.transpose(gathered, (1, 0, 2))
+
+
+def alignment_scan(
+    subs_costs: Array,
+    ins_costs: Array,
+    del_cost: Array,
+    seq_lens: Array,
+    minop: Callable[[Array], Array],
+    inf: float = 1e9,
+) -> Array:
+  """Single-state edit DP over anti-diagonals (alignment loss core).
+
+  Args:
+    subs_costs: [B, m, n] substitution costs.
+    ins_costs: [B, n] insertion costs (consuming a predicted base).
+    del_cost: scalar cost of deleting a true base.
+    seq_lens: [B] true sequence lengths (excluding padding).
+    minop: soft or hard minimum over the leading axis of a [3, ...] stack.
+    inf: large positive float.
+
+  Returns:
+    [B] alignment scores, evaluated at cell (seq_lens[b], n).
+  """
+  batch, m, n = subs_costs.shape
+  subs_w = wavefrontify(subs_costs)  # [m+n-1, B, m]
+  ins_w = wavefrontify_vec(ins_costs, m + 1)  # [m+n, B, m+1]
+
+  i_range = jnp.arange(m + 1)
+  k_end = seq_lens + n
+
+  v_p2 = jnp.full((batch, m), inf).at[:, 0].set(0.0)
+  v_p1 = jnp.concatenate(
+      [
+          ins_w[0][:, :1],
+          jnp.full((batch, 1), del_cost),
+          jnp.full((batch, m - 1), inf),
+      ],
+      axis=1,
+  )
+  v_opt = jnp.full((batch,), inf)
+
+  ks = jnp.arange(2, m + n + 1)
+
+  def step(carry, xs):
+    v_p2, v_p1, v_opt = carry
+    k, subs_k, ins_k = xs  # subs_k: [B, m], ins_k: [B, m+1]
+    j_range = k - i_range
+    valid = (j_range >= 0) & (j_range <= n)  # [m+1]
+
+    o_m = v_p2 + subs_k
+    o_i = v_p1 + ins_k
+    v_p2_next = v_p1[:, :-1]
+    o_d = v_p2_next + del_cost
+
+    body = minop(jnp.stack([o_m, o_i[:, 1:], o_d]))  # [B, m]
+    v_new = jnp.concatenate([o_i[:, :1], body], axis=1)
+    v_new = jnp.where(valid[None, :], v_new, inf)
+    v_at_len = jnp.take_along_axis(v_new, seq_lens[:, None], axis=1)[:, 0]
+    v_opt = jnp.where(k_end == k, v_at_len, v_opt)
+    return (v_p2_next, v_new, v_opt), None
+
+  (_, _, v_opt), _ = jax.lax.scan(
+      step, (v_p2, v_p1, v_opt), (ks, subs_w, ins_w[1:])
+  )
+  return v_opt
+
+
+def banded_alignment_scan(
+    subs_costs: Array,
+    ins_costs: Array,
+    del_cost: Array,
+    seq_lens: Array,
+    width: int,
+    minop: Callable[[Array], Array],
+    inf: float = 1e9,
+) -> Array:
+  """Band-restricted edit DP in (anti-diagonal, offset) coordinates.
+
+  Replicates the reference's woven-band recursion
+  (losses_and_metrics.py:413-547) without materializing the woven
+  tensors. Cell (x, y) — x true bases consumed, y predicted bases
+  consumed — lives at band[k=x+y, d=y-x+width] (the weave_band example
+  and index_ending_band agree on d=y-x+width; the docstring formula in
+  the reference contradicts its own example). Moves into (x, y):
+  diagonal subs[x-1, y-1], deletion from (x-1, y) at del_cost, and
+  insertion from (x, y-1) at ins[y-1]. Evaluation fetches
+  (seq_lens, min(n, seq_lens + width)): trailing predicted positions
+  outside the band are never charged. Requires square inputs (m == n),
+  which holds for fixed-length windows.
+  """
+  batch, m, n = subs_costs.shape
+  if m != n:
+    raise ValueError('banded alignment requires m == n')
+  n_diag = 2 * width + 1
+  length = m + 1  # DP matrix side
+
+  d = jnp.arange(n_diag)
+
+  # k=0: only cell (0, 0) -> value 0.
+  band_p2 = jnp.where((d == width)[None], 0.0, jnp.full((batch, n_diag), inf))
+  # k=1: cells (1, 0) [d=width-1] and (0, 1) [d=width+1], taken from
+  # the reference's boundary init (V[x, 0] = x*del, V[0, y] = cum-ins).
+  band_p1 = jnp.full((batch, n_diag), inf)
+  if width >= 1:
+    band_p1 = band_p1.at[:, width - 1].set(del_cost)
+    band_p1 = band_p1.at[:, width + 1].set(ins_costs[:, 0])
+
+  # Cell coordinates for band slot (k, d): 2x = k - d + width,
+  # 2y = k + d - width; odd parity slots hold no cell.
+  def subs_at(k):
+    x2 = k - d + width
+    y2 = k + d - width
+    valid = (x2 % 2 == 0) & (x2 >= 2) & (y2 >= 2) & (x2 <= 2 * m) & (
+        y2 <= 2 * n
+    )
+    xi = jnp.clip(x2 // 2 - 1, 0, m - 1)
+    yi = jnp.clip(y2 // 2 - 1, 0, n - 1)
+    vals = subs_costs[:, xi, yi]  # [B, n_diag]
+    return jnp.where(valid[None], vals, inf)
+
+  def ins_at(k):
+    # Insertion into (x, y) consumes predicted base y at ins[y-1]
+    # (ins_pad[0] = 0 per the reference's padded column).
+    x2 = k - d + width
+    y2 = k + d - width
+    valid = (x2 % 2 == 0) & (x2 >= 0) & (y2 >= 0)
+    y = jnp.clip(y2 // 2, 0, n)
+    ins_pad = jnp.concatenate([jnp.zeros((batch, 1)), ins_costs], axis=1)
+    vals = ins_pad[:, y]
+    return jnp.where(valid[None], vals, inf)
+
+  ks = jnp.arange(2, 2 * length - 1)
+
+  def step(carry, k):
+    band_p2, band_p1 = carry
+    o_m = band_p2 + subs_at(k)
+    shifted_up = jnp.concatenate(
+        [band_p1[:, 1:], jnp.full((batch, 1), inf)], axis=1
+    )
+    o_d = shifted_up + del_cost
+    shifted_down = jnp.concatenate(
+        [jnp.full((batch, 1), inf), band_p1[:, :-1]], axis=1
+    )
+    o_i = shifted_down + ins_at(k)
+    new = minop(jnp.stack([o_m, o_d, o_i]))
+    return (band_p1, new), new
+
+  (_, _), rows = jax.lax.scan(step, (band_p2, band_p1), ks)
+  # rows: [2*length-3, B, n_diag] for k = 2..2*length-2.
+  all_rows = jnp.concatenate(
+      [band_p2[None], band_p1[None], rows], axis=0
+  )  # [2*length-1, B, n_diag]
+
+  # Fetch (x, y) = (seq_lens, min(n, seq_lens + width))
+  # (reference index_ending_band: losses_and_metrics.py:458-473).
+  x_end = seq_lens
+  y_end = jnp.minimum(n, seq_lens + width)
+  k_end = x_end + y_end
+  d_end = y_end - x_end + width
+  return all_rows[k_end, jnp.arange(batch), d_end]

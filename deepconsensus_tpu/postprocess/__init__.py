@@ -1,0 +1,5 @@
+from deepconsensus_tpu.postprocess.stitch import (  # noqa: F401
+    DCModelOutput,
+    OutcomeCounter,
+    stitch_to_fastq,
+)

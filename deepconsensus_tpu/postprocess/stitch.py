@@ -1,0 +1,115 @@
+"""Stitching model-output windows back into full reads.
+
+Equivalent of the reference's postprocess stage (reference:
+deepconsensus/postprocess/stitch_utils.py:39-189): concatenate sorted
+windows, fail (or N-fill) on missing windows, strip gap columns, apply
+quality/length filters, and emit FASTQ text.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.utils import phred
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class DCModelOutput:
+  molecule_name: str
+  window_pos: int
+  ec: Optional[float] = None
+  np_num_passes: Optional[int] = None
+  rq: Optional[float] = None
+  rg: Optional[str] = None
+  sequence: Optional[str] = None
+  quality_string: Optional[str] = None
+
+
+@dataclasses.dataclass
+class OutcomeCounter:
+  empty_sequence: int = 0
+  only_gaps: int = 0
+  failed_quality_filter: int = 0
+  failed_length_filter: int = 0
+  success: int = 0
+
+
+def get_full_sequence(
+    outputs: Iterable[DCModelOutput],
+    max_length: int,
+    fill_n: bool = False,
+) -> Tuple[Optional[str], str]:
+  """Concatenates sorted windows; missing windows fail the read unless
+  fill_n pads them with Ns (reference: stitch_utils.py:51-81)."""
+  sequence_parts = []
+  quality_parts = []
+  start = 0
+  for out in outputs:
+    while out.window_pos > start:
+      if not fill_n:
+        return None, ''
+      sequence_parts.append('N' * max_length)
+      quality_parts.append(
+          phred.quality_scores_to_string([constants.EMPTY_QUAL] * max_length)
+      )
+      start += max_length
+    sequence_parts.append(out.sequence)
+    quality_parts.append(out.quality_string)
+    start += max_length
+  return ''.join(sequence_parts), ''.join(quality_parts)
+
+
+def remove_gaps(sequence: str, quality_string: str) -> Tuple[str, str]:
+  """Drops gap columns and their quality values."""
+  seq = np.frombuffer(sequence.encode('ascii'), dtype=np.uint8)
+  qual = np.frombuffer(quality_string.encode('ascii'), dtype=np.uint8)
+  keep = seq != ord(constants.GAP)
+  return (
+      seq[keep].tobytes().decode('ascii'),
+      qual[keep].tobytes().decode('ascii'),
+  )
+
+
+def is_quality_above_threshold(quality_string: str, min_quality: int) -> bool:
+  scores = phred.quality_string_to_array(quality_string)
+  # Round to dodge float noise right at the threshold
+  # (reference: stitch_utils.py:101-109).
+  return round(phred.avg_phred(scores), 5) >= min_quality
+
+
+def format_as_fastq(name: str, sequence: str, quality_string: str) -> str:
+  return f'@{name}\n{sequence}\n+\n{quality_string}\n'
+
+
+def stitch_to_fastq(
+    molecule_name: str,
+    predictions: Iterable[DCModelOutput],
+    max_length: int,
+    min_quality: int,
+    min_length: int,
+    outcome_counter: OutcomeCounter,
+) -> Optional[str]:
+  """Stitch + filter + format one molecule
+  (reference: stitch_utils.py:131-189)."""
+  full_seq, full_qual = get_full_sequence(predictions, max_length)
+  if not full_seq:
+    outcome_counter.empty_sequence += 1
+    return None
+  final_seq, final_qual = remove_gaps(full_seq, full_qual)
+  if not final_seq:
+    outcome_counter.only_gaps += 1
+    return None
+  if not is_quality_above_threshold(final_qual, min_quality):
+    outcome_counter.failed_quality_filter += 1
+    return None
+  if len(final_seq) < min_length:
+    outcome_counter.failed_length_filter += 1
+    return None
+  outcome_counter.success += 1
+  return format_as_fastq(molecule_name, final_seq, final_qual)

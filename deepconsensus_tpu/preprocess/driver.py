@@ -1,0 +1,118 @@
+"""`preprocess` driver: BAMs -> per-split gzip TFRecord shards + summary.
+
+Equivalent of the reference's preprocess binary (reference:
+deepconsensus/preprocess/preprocess.py:63-361): optional worker-pool
+featurization with a single writer, @split filename templating, and a
+JSON summary combining counters, layout, and flags.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import multiprocessing
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.io.tfrecord import TFRecordWriter
+from deepconsensus_tpu.preprocess.feeder import create_proc_feeder
+from deepconsensus_tpu.preprocess.pileup import FeatureLayout
+from deepconsensus_tpu.preprocess.feeder import reads_to_pileup
+
+
+def _process_zmw(args) -> Tuple[List[bytes], str, Dict[str, int]]:
+  """Featurizes one ZMW into serialized examples (worker side)."""
+  subreads, name, layout, split, window_widths = args
+  pileup = reads_to_pileup(subreads, name, layout, window_widths)
+  serialized = [w.to_example().serialize() for w in pileup.iter_windows()]
+  return serialized, split, dict(pileup.counter)
+
+
+def run_preprocess(
+    subreads_to_ccs: str,
+    ccs_bam: str,
+    output: str,
+    max_passes: int = 20,
+    example_width: int = 100,
+    use_ccs_bq: bool = False,
+    ins_trim: int = 5,
+    use_ccs_smart_windows: bool = False,
+    truth_bed: Optional[str] = None,
+    truth_to_ccs: Optional[str] = None,
+    truth_split: Optional[str] = None,
+    limit: int = 0,
+    cpus: int = 0,
+) -> Dict[str, int]:
+  """Writes examples to `output` ('@split' expands per split).
+
+  Returns the combined counter. With cpus>0 featurization fans out to a
+  process pool while the main process remains the single writer
+  (reference: preprocess.py:297-332).
+  """
+  is_training = bool(truth_bed and truth_to_ccs and truth_split)
+  splits = ('train', 'eval', 'test') if is_training else ('inference',)
+  if '@split' not in output and is_training:
+    raise ValueError('training output path must contain @split')
+
+  layout = FeatureLayout(max_passes, example_width, use_ccs_bq)
+  feeder, counter = create_proc_feeder(
+      subreads_to_ccs=subreads_to_ccs,
+      ccs_bam=ccs_bam,
+      layout=layout,
+      ins_trim=ins_trim,
+      use_ccs_smart_windows=use_ccs_smart_windows,
+      truth_bed=truth_bed,
+      truth_to_ccs=truth_to_ccs,
+      truth_split=truth_split,
+      limit=limit,
+  )
+
+  writers = {}
+  for split in splits:
+    path = output.replace('@split', split)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    writers[split] = TFRecordWriter(path)
+
+  agg: collections.Counter = collections.Counter()
+
+  def consume(result):
+    serialized, split, zmw_counter = result
+    agg.update(zmw_counter)
+    for record in serialized:
+      writers[split].write(record)
+      agg[f'n_examples_{split}'] += 1
+      agg['n_examples'] += 1
+
+  if cpus and cpus > 1:
+    with multiprocessing.Pool(cpus) as pool:
+      for result in pool.imap(_process_zmw, feeder(), chunksize=4):
+        consume(result)
+  else:
+    for item in feeder():
+      consume(_process_zmw(item))
+
+  for w in writers.values():
+    w.close()
+
+  summary = dict(counter)
+  summary.update(agg)
+  summary.update(layout.to_dict())
+  summary.update({
+      'subreads_to_ccs': subreads_to_ccs,
+      'ccs_bam': ccs_bam,
+      'truth_to_ccs': truth_to_ccs or '',
+      'truth_bed': truth_bed or '',
+      'truth_split': truth_split or '',
+      'ins_trim': str(ins_trim),
+      'version': constants.__version__,
+  })
+  mode = 'training' if is_training else 'inference'
+  summary_path = (
+      output.replace('@split', 'summary').rsplit('.tfrecord', 1)[0]
+      + f'.summary.{mode}.json'
+  )
+  with open(summary_path, 'w') as f:
+    json.dump(summary, f, indent=1)
+  return summary

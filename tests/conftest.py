@@ -6,12 +6,18 @@ compile checks.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8'
   ).strip()
+
+# The environment may pin JAX_PLATFORMS to a TPU plugin; the config
+# knob takes precedence over whatever the plugin registers.
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pathlib
 

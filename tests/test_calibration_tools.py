@@ -1,0 +1,75 @@
+"""Tests for filter_reads and the calibration measurement on the
+reference's bundled prediction_assessment testdata."""
+import csv
+import gzip
+
+import pytest
+
+from deepconsensus_tpu.calibration import filter_reads, measure
+from deepconsensus_tpu.io import fastx
+from deepconsensus_tpu.utils import phred
+
+
+@pytest.mark.parametrize('q', [0, 10, 20, 30, 40])
+def test_filter_fastq_matches_reference_goldens(testdata_dir, tmp_path, q):
+  """The reference ships pre-filtered fastqs for each threshold
+  (reference filter_reads_test.py:47-151)."""
+  src = str(
+      testdata_dir
+      / 'filter_fastq/m64062_190806_063919_q0_chr20_100reads.fq.gz'
+  )
+  out = str(tmp_path / f'filtered.q{q}.fq.gz')
+  filter_reads.filter_bam_or_fastq_by_quality(src, out, q)
+  golden = str(
+      testdata_dir
+      / f'filter_fastq/m64062_190806_063919_q0_chr20_100reads.q{q}.fq.gz'
+  )
+  got = list(fastx.read_fastq(out))
+  want = list(fastx.read_fastq(golden))
+  assert [g[0] for g in got] == [w[0] for w in want]
+  assert [g[1] for g in got] == [w[1] for w in want]
+
+
+def test_filter_bam_input(testdata_dir, tmp_path):
+  src = str(
+      testdata_dir / 'filter_fastq/m64062_190806_063919-chr20.dc.small.bam'
+  )
+  out = str(tmp_path / 'from_bam.q30.fq.gz')
+  kept = filter_reads.filter_bam_or_fastq_by_quality(src, out, 30)
+  golden = list(fastx.read_fastq(
+      str(testdata_dir
+          / 'filter_fastq/m64062_190806_063919-chr20.dc.small.q30.fq.gz')
+  ))
+  got = list(fastx.read_fastq(out))
+  assert kept == len(golden)
+  assert [g[0].split()[0] for g in got] == [w[0].split()[0] for w in golden]
+
+
+def test_calibration_measurement_runs(testdata_dir, tmp_path):
+  bam = str(
+      testdata_dir
+      / 'prediction_assessment/CHM13_chr20_0_200000_dc.to_truth.bam'
+  )
+  ref = str(testdata_dir / 'prediction_assessment/CHM13_chr20_0_200000.fa')
+  out = str(tmp_path / 'calib.csv')
+  rows = measure.calculate_quality_calibration(
+      bam=bam, ref=ref, output=out, min_mapq=0
+  )
+  total_m = sum(r[1] for r in rows)
+  total_x = sum(r[2] for r in rows)
+  assert total_m > 0
+  # Predictions should overwhelmingly match the truth reference.
+  assert total_m > total_x * 10
+  with open(out) as f:
+    header = next(csv.reader(f))
+  assert header == ['baseq', 'total_match', 'total_mismatch']
+
+
+def test_get_contig_regions():
+  regions = measure.get_contig_regions({'chr1': 2500})
+  assert len(regions) == 3
+  assert regions[0].start == 0 and regions[0].stop == 999
+  assert regions[-1].stop == 2500 - 1 + 1 or regions[-1].stop == 2499
+  regions = measure.get_contig_regions({'chr1': 2500}, region='chr1:100-300')
+  assert len(regions) == 1
+  assert regions[0].start == 100 and regions[0].stop == 300

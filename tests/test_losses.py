@@ -1,0 +1,176 @@
+"""Golden tests for AlignmentLoss/AlignmentMetric against the expected
+values enumerated in the reference's losses_and_metrics_test.py."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.models import losses, metrics
+
+
+def seq_to_array(seq):
+  return np.array([constants.SEQ_VOCAB.index(c) for c in seq], np.float32)
+
+
+def seq_to_one_hot(seq):
+  eye = np.eye(len(constants.SEQ_VOCAB), dtype=np.float32)
+  return np.stack([eye[constants.SEQ_VOCAB.index(c)] for c in seq])
+
+
+def convert_seqs(sequences):
+  y_true = np.stack([seq_to_array(s) for s in sequences[0]])
+  y_pred = np.stack([seq_to_one_hot(s) for s in sequences[1]])
+  return jnp.asarray(y_true), jnp.asarray(y_pred)
+
+
+def test_left_shift_sequence():
+  y = jnp.asarray([[0, 1, 0, 2, 3, 0], [4, 0, 0, 3, 0, 0]])
+  out = np.asarray(losses.left_shift_sequence(y))
+  np.testing.assert_array_equal(out, [[1, 2, 3, 0, 0, 0], [4, 3, 0, 0, 0, 0]])
+
+
+ALIGNMENT_LOSS_CASES = [
+    # (true, pred, del_cost, loss_reg, width, expected)
+    ((['TTAGGC', 'AGCTGG'], ['TTAGGC', 'AGCTGG']), 1.0, None, None, 0.0),
+    ((['TTAGGC    ', 'AGCTGG    '],
+      ['TTAGGC    ', 'AGCTGG    ']), 1.0, None, None, 0.0),
+    ((['TTAGGCAT', 'AGCTGG  '],
+      ['TTAGGCAT  ', 'AGCTGG    ']), 1.0, None, None, 0.0),
+    ((['TTAGGC', 'AGCTGG'], ['T TA G G C', 'AGC    TGG']),
+     1.0, None, None, 0.0),
+    ((['TTAGGC    ', 'AGCTGG    '],
+      ['TTA G GC  ', 'AGC    TGG']), 1.0, None, None, 0.0),
+    ((['TTAGGC', 'AGCTGG'], ['TTAGG ', 'GCTGG ']), 1.0, None, None, 1.0),
+    ((['TTAGGC', 'AGCTGG'], ['TAGGC ', 'AGCGG ']), 2.0, None, None, 2.0),
+    ((['TTAGGC', 'AGCTGG'], ['TTAG  ', 'GCGG  ']), 1.0, None, None, 2.0),
+    ((['TTAGGC', 'AGCTGG'], ['ATAGGC', 'TGCTGG']), 1.0, None, None, 16.118),
+    ((['TTAGGC', 'AGCTGG'], ['AAAGGC', 'TGCTGC']), 1.0, None, None, 32.236),
+    ((['TTAGGC', 'ATCGAC', 'AGCTGG'],
+      ['TTAGGCA', 'ATCCGAC', 'CAGCTGG']), 1.0, None, None, 16.118),
+    ((['ATCG ', 'ATCG '], ['TCG  ', 'TCG  ']), 1.0, None, None, 1.0),
+    ((['ATCG ', 'ATCG '], ['TCG  ', 'TCG  ']), 1e9, None, None, 64.472),
+    # Banded cases.
+    ((['TTAGGC', 'AGCTGG'], ['TTAGGC', 'AGCTGG']), 1.0, None, 2, 0.0),
+    ((['TTAGGC', 'AGCTGG'], ['TTAGG ', 'GCTGG ']), 1.0, None, 2, 1.0),
+    ((['TTAGGC    ', 'AGCTGG    '],
+      ['TTAGGC    ', 'AGCTGG    ']), 1.0, None, 1, 0.0),
+    ((['TTAGGC   ', 'AGCTG   G'], ['T TAG G C', 'AGC   TGG']),
+     1.0, None, 8, 0.0),
+    ((['TTAGGC    ', 'AGCTGG    '],
+      ['TTA G GC  ', 'AGC    TGG']), 1.0, None, 8, 0.0),
+    ((['TTAGGC', 'AGCTGG'], ['AAAGGC', 'TGCTGC']), 1.0, None, 4, 32.236),
+    ((['TTA', 'GGC'], ['A  ', 'C  ']), 1.0, None, 2, 2.0),
+    ((['TTA', 'GGC'], ['A  ', 'C  ']), 1.0, None, 1, 18.118),
+]
+
+
+@pytest.mark.parametrize(
+    'sequences,del_cost,loss_reg,width,expected', ALIGNMENT_LOSS_CASES
+)
+def test_alignment_loss(sequences, del_cost, loss_reg, width, expected):
+  y_true, y_pred = convert_seqs(sequences)
+  loss = losses.AlignmentLoss(
+      del_cost=del_cost, loss_reg=loss_reg, width=width
+  )
+  got = float(loss(y_true, y_pred))
+  assert got == pytest.approx(expected, abs=2e-2)
+
+
+def test_soft_alignment_close_to_hard_for_small_reg():
+  sequences = (['TTAGGC', 'AGCTGG'], ['TTAGG ', 'GCTGG '])
+  y_true, y_pred = convert_seqs(sequences)
+  hard = float(losses.AlignmentLoss(del_cost=1.0, loss_reg=None)(
+      y_true, y_pred))
+  soft = float(losses.AlignmentLoss(del_cost=1.0, loss_reg=0.01)(
+      y_true, y_pred))
+  assert soft == pytest.approx(hard, abs=0.1)
+
+
+def test_alignment_loss_differentiable():
+  import jax
+  sequences = (['TTAGGC'], ['TTAGG '])
+  y_true, y_pred = convert_seqs(sequences)
+  # Soften the one-hot so probabilities sit inside the clip range
+  # (exact one-hots have zero gradient at the clip boundaries).
+  y_pred = y_pred * 0.9 + 0.02
+  loss = losses.AlignmentLoss(del_cost=10.0, loss_reg=0.1)
+  grad = jax.grad(lambda p: loss(y_true, p))(y_pred)
+  assert np.isfinite(np.asarray(grad)).all()
+  assert np.abs(np.asarray(grad)).sum() > 0
+
+
+ALIGNMENT_METRIC_CASES = [
+    ((['TTAGGC', 'AGCTGG'], ['TTAGGC', 'AGCTGG']), (1.0, 1.0)),
+    ((['TTAGGC', 'AGCTGG'], ['AAAGGC', 'TGCTGC']), (0.667, 0.667)),
+    ((['TTAGGC', 'AGCTGG'], ['T TA G G C', 'AGC    TGG']), (1.0, 1.0)),
+    ((['TTAGGC', 'AGCTGG'], ['TTAGG ', 'GCTGG ']), (0.833, 0.833)),
+    ((['TTAGGC', 'ATCGAC', 'AGCTGG'],
+      ['TTAGGCA', 'ATCCGAC', 'CAGCTGG']), (0.857, 0.857, 0.857)),
+    ((['ATCG ', 'ATCG '], ['TCG  ', 'TCG  ']), (0.75, 0.75)),
+    ((['ATCG ', 'ATCG '], ['     ', '     ']), (0.0, 0.0)),
+    ((['     ', '     '], ['ATCG ', 'ATCG ']), (0.0, 0.0)),
+    ((['A    ', 'T    '], ['     ', '     ']), (0.0, 0.0)),
+    ((['     ', '     '], ['A    ', 'T    ']), (0.0, 0.0)),
+    ((['     ', '     '], ['     ', '     ']), (1.0, 1.0)),
+]
+
+
+@pytest.mark.parametrize('sequences,expected_pid', ALIGNMENT_METRIC_CASES)
+def test_alignment_metric_pid(sequences, expected_pid):
+  y_true, y_pred = convert_seqs(sequences)
+  metric = metrics.AlignmentMetric()
+  _, _, mv = metric.alignment(y_true, y_pred)
+  pid = np.asarray(mv['pid'])
+  for i, want in enumerate(expected_pid):
+    assert pid[i] == pytest.approx(want, abs=2e-3), (i, pid)
+
+
+def test_per_example_accuracy():
+  y_true = jnp.asarray(np.stack([
+      seq_to_array('A T C G'),
+      seq_to_array('T T T T'),
+      seq_to_array('A A A A'),
+  ]))
+  y_pred = jnp.asarray(np.stack([
+      seq_to_one_hot('   ATCG'),
+      seq_to_one_hot('   GGGG'),
+      seq_to_one_hot('   AAAA'),
+  ]))
+  correct, total = metrics.per_example_accuracy_counts(y_true, y_pred)
+  assert int(correct) == 2 and int(total) == 3
+
+
+def test_per_class_accuracy():
+  # y_true = A A T gap; prediction decodes to A A T G.
+  y_true = jnp.asarray([[1, 1, 2, 0]], dtype=jnp.float32)
+  y_pred = jnp.asarray([seq_to_one_hot('AATG')])
+  correct, total = metrics.per_class_accuracy_counts(y_true, y_pred, 1)
+  assert int(correct) == 2 and int(total) == 2
+  correct, total = metrics.per_class_accuracy_counts(y_true, y_pred, 2)
+  assert int(correct) == 1 and int(total) == 1
+  correct, total = metrics.per_class_accuracy_counts(y_true, y_pred, 0)
+  assert int(correct) == 0 and int(total) == 1
+
+
+def test_batch_identity_and_yield():
+  sequences = (['TTAGGC', 'AGCTGG'], ['TTAGGC', 'AGCTGG'])
+  y_true, y_pred = convert_seqs(sequences)
+  ccs = y_true
+  metric = metrics.AlignmentMetric()
+  id_ccs, id_pred = metrics.batch_identity_ccs_pred(
+      ccs, y_pred, y_true, metric
+  )
+  assert float(id_ccs) == pytest.approx(1.0)
+  assert float(id_pred) == pytest.approx(1.0)
+  y = metrics.YieldOverCCS()
+  y.update(float(id_ccs), float(id_pred))
+  assert y.result() == 1.0
+
+
+def test_distillation_loss_zero_for_identical():
+  logits = jnp.asarray(np.random.default_rng(0).normal(size=(2, 10, 5)),
+                       dtype=jnp.float32)
+  assert float(losses.distillation_loss(logits, logits)) == 0.0
+  other = logits + 1.0  # softmax-invariant shift -> still zero
+  assert float(losses.distillation_loss(logits, other)) == pytest.approx(
+      0.0, abs=1e-6)

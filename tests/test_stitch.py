@@ -1,0 +1,77 @@
+from deepconsensus_tpu.postprocess import stitch
+
+
+def make_output(pos, seq, qual_char='I'):
+  return stitch.DCModelOutput(
+      molecule_name='m/1/ccs',
+      window_pos=pos,
+      sequence=seq,
+      quality_string=qual_char * len(seq),
+  )
+
+
+def test_stitch_simple():
+  outs = [make_output(0, 'ACGT'), make_output(4, 'TTGG')]
+  counter = stitch.OutcomeCounter()
+  fastq = stitch.stitch_to_fastq('m/1/ccs', outs, 4, 0, 0, counter)
+  assert fastq == '@m/1/ccs\nACGTTTGG\n+\nIIIIIIII\n'
+  assert counter.success == 1
+
+
+def test_stitch_removes_gaps():
+  outs = [make_output(0, 'AC T')]
+  counter = stitch.OutcomeCounter()
+  fastq = stitch.stitch_to_fastq('m/1/ccs', outs, 4, 0, 0, counter)
+  assert fastq.splitlines()[1] == 'ACT'
+  assert len(fastq.splitlines()[3]) == 3
+
+
+def test_stitch_missing_window_fails():
+  outs = [make_output(4, 'TTGG')]  # window 0 missing
+  counter = stitch.OutcomeCounter()
+  fastq = stitch.stitch_to_fastq('m/1/ccs', outs, 4, 0, 0, counter)
+  assert fastq is None
+  assert counter.empty_sequence == 1
+
+
+def test_quality_filter():
+  outs = [make_output(0, 'ACGT', qual_char='+')]  # q10
+  counter = stitch.OutcomeCounter()
+  assert stitch.stitch_to_fastq('m/1/ccs', outs, 4, 20, 0, counter) is None
+  assert counter.failed_quality_filter == 1
+  # Threshold exactly at the read quality passes (rounding guard).
+  counter = stitch.OutcomeCounter()
+  assert stitch.stitch_to_fastq(
+      'm/1/ccs', [make_output(0, 'ACGT', qual_char='+')], 4, 10, 0, counter
+  ) is not None
+
+
+def test_length_filter():
+  outs = [make_output(0, 'AC  ')]
+  counter = stitch.OutcomeCounter()
+  assert stitch.stitch_to_fastq('m/1/ccs', outs, 4, 0, 5, counter) is None
+  assert counter.failed_length_filter == 1
+
+
+def test_only_gaps():
+  outs = [make_output(0, '    ')]
+  counter = stitch.OutcomeCounter()
+  assert stitch.stitch_to_fastq('m/1/ccs', outs, 4, 0, 0, counter) is None
+  assert counter.only_gaps == 1
+
+
+def test_calibration_lib():
+  import numpy as np
+  from deepconsensus_tpu.calibration import lib
+
+  cv = lib.parse_calibration_string('skip')
+  assert not cv.enabled
+  cv = lib.parse_calibration_string('10,0.9,1.5')
+  assert cv.enabled and cv.threshold == 10 and cv.w == 0.9 and cv.b == 1.5
+  scores = np.array([5.0, 20.0])
+  out = lib.calibrate_quality_scores(scores, cv)
+  np.testing.assert_allclose(out, [5.0, 20 * 0.9 + 1.5])
+  cv0 = lib.parse_calibration_string('0,2.0,1.0')
+  np.testing.assert_allclose(
+      lib.calibrate_quality_scores(scores, cv0), scores * 2 + 1
+  )
