@@ -113,6 +113,7 @@ def run_preprocess(
       output.replace('@split', 'summary').rsplit('.tfrecord', 1)[0]
       + f'.summary.{mode}.json'
   )
+  os.makedirs(os.path.dirname(os.path.abspath(summary_path)), exist_ok=True)
   with open(summary_path, 'w') as f:
     json.dump(summary, f, indent=1)
   return summary

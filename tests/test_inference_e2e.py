@@ -1,0 +1,144 @@
+"""End-to-end inference pipeline tests on the bundled human_1m BAMs."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.io import fastx
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+
+
+@pytest.fixture(scope='module')
+def small_runner():
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 64
+  options = runner_lib.InferenceOptions(batch_size=32, batch_zmws=4, limit=3)
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  return runner_lib.ModelRunner(params, variables, options), options
+
+
+def test_run_inference_end_to_end(testdata_dir, tmp_path, small_runner):
+  runner, options = small_runner
+  out = str(tmp_path / 'out.fastq')
+  counters = runner_lib.run_inference(
+      subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+      ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+      checkpoint=None,
+      output=out,
+      options=options,
+      runner=runner,
+  )
+  assert counters['n_zmw_pass'] == 3
+  # With an untrained model most reads fail the q20 filter, but the
+  # pipeline must produce its sidecar outputs and consistent counts.
+  assert os.path.exists(out + '.runtime.csv')
+  assert os.path.exists(out + '.inference.json')
+  with open(out + '.inference.json') as f:
+    saved = json.load(f)
+  assert saved['n_zmw_pass'] == 3
+  total_outcomes = (
+      saved['success'] + saved['empty_sequence'] + saved['only_gaps']
+      + saved['failed_quality_filter'] + saved['failed_length_filter']
+  )
+  assert total_outcomes == 3
+
+
+def test_skip_windows_adopt_ccs(testdata_dir, tmp_path, small_runner):
+  """With skip_windows_above=1 every window adopts the CCS sequence, so
+  outputs equal the draft CCS reads (quality-filtered)."""
+  runner, _ = small_runner
+  options = runner_lib.InferenceOptions(
+      batch_size=32, batch_zmws=4, limit=2, skip_windows_above=1,
+      min_quality=0,
+  )
+  out = str(tmp_path / 'ccs_passthrough.fastq')
+  counters = runner_lib.run_inference(
+      subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+      ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+      checkpoint=None,
+      output=out,
+      options=options,
+      runner=runner,
+  )
+  assert counters.get('n_windows_to_model', 0) == 0
+  assert counters['n_windows_quality_skipped'] > 0
+  reads = list(fastx.read_fastq(out))
+  assert len(reads) == counters['success'] > 0
+
+  # Compare against the raw CCS bases for those molecules.
+  from deepconsensus_tpu.io import bam as bam_lib
+
+  ccs_by_name = {}
+  for rec in bam_lib.BamReader(str(testdata_dir / 'human_1m/ccs.bam')):
+    ccs_by_name[rec.qname] = rec.seq
+  for name, seq, qual in reads:
+    assert name in ccs_by_name
+    # Windows only cover CCS coordinates present in subread alignments,
+    # so the stitched read is a prefix-slice of the CCS draft.
+    assert seq in ccs_by_name[name]
+    assert len(seq) == len(qual)
+
+
+def test_preprocess_driver_matches_feeder(testdata_dir, tmp_path):
+  from deepconsensus_tpu.preprocess.driver import run_preprocess
+  from deepconsensus_tpu.io import tfrecord
+  from deepconsensus_tpu.io.example_proto import Example
+
+  td = str(testdata_dir / 'human_1m')
+  out = str(tmp_path / 'examples' / '@split' / '@split.tfrecord.gz')
+  summary = run_preprocess(
+      subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+      ccs_bam=f'{td}/ccs.bam',
+      output=out,
+      ins_trim=5,
+      truth_bed=f'{td}/truth.bed',
+      truth_to_ccs=f'{td}/truth_to_ccs.bam',
+      truth_split=f'{td}/truth_split.tsv',
+      limit=3,
+  )
+  assert summary['n_zmw_pass'] == 3
+  n = 0
+  for split in ('train', 'eval', 'test'):
+    path = out.replace('@split', split)
+    for raw in tfrecord.read_tfrecords(path):
+      ex = Example.parse(raw)
+      assert ex['subreads/shape'] == [85, 100, 1]
+      n += 1
+  assert n == summary['n_examples']
+
+
+def test_preprocess_driver_multiprocess_equivalence(testdata_dir, tmp_path):
+  from deepconsensus_tpu.preprocess.driver import run_preprocess
+  from deepconsensus_tpu.io import tfrecord
+
+  td = str(testdata_dir / 'human_1m')
+  out_serial = str(tmp_path / 'serial' / '@split.tfrecord.gz')
+  out_mp = str(tmp_path / 'mp' / '@split.tfrecord.gz')
+  kwargs = dict(
+      subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+      ccs_bam=f'{td}/ccs.bam',
+      ins_trim=5,
+      truth_bed=f'{td}/truth.bed',
+      truth_to_ccs=f'{td}/truth_to_ccs.bam',
+      truth_split=f'{td}/truth_split.tsv',
+      limit=4,
+  )
+  s1 = run_preprocess(output=out_serial, cpus=0, **kwargs)
+  s2 = run_preprocess(output=out_mp, cpus=2, **kwargs)
+  assert s1['n_examples'] == s2['n_examples']
+  for split in ('train', 'eval', 'test'):
+    a = list(tfrecord.read_tfrecords(out_serial.replace('@split', split)))
+    b = list(tfrecord.read_tfrecords(out_mp.replace('@split', split)))
+    assert a == b  # imap preserves order -> byte-identical shards
