@@ -272,7 +272,42 @@ def run_inference(
   timing_rows: List[Dict[str, Any]] = []
   fastq_lines = 0
 
-  with open(output, 'w') as out_f:
+  if output.endswith('.bam'):
+    from deepconsensus_tpu.io.bam_writer import BamWriter
+
+    writer = BamWriter(output, header_text='@HD\tVN:1.5\tSO:unknown\n')
+
+    def emit(fastq_str: str, dc_outputs) -> None:
+      name, seq, _, qual = fastq_str.rstrip('\n').split('\n')
+      first = dc_outputs[0]
+      tags = {}
+      if first.ec is not None:
+        tags['ec'] = float(first.ec)
+      if first.np_num_passes is not None:
+        tags['np'] = int(first.np_num_passes)
+      if first.rq is not None:
+        tags['rq'] = float(first.rq)
+      if first.rg is not None:
+        tags['RG'] = str(first.rg)
+      tags['zm'] = int(name[1:].split('/')[1])
+      writer.write(
+          name[1:],
+          seq,
+          np.array(phred.quality_string_to_array(qual), dtype=np.uint8),
+          tags=tags,
+      )
+
+    close_out = writer.close
+  else:
+    writer = open(output, 'w')
+
+    def emit(fastq_str: str, dc_outputs) -> None:
+      del dc_outputs
+      writer.write(fastq_str)
+
+    close_out = writer.close
+
+  try:
 
     def flush_zmw_batch(zmw_batch):
       nonlocal fastq_lines
@@ -299,6 +334,7 @@ def run_inference(
       for name, group in itertools.groupby(
           predictions, key=lambda p: p.molecule_name
       ):
+        group = list(group)
         fastq = stitch.stitch_to_fastq(
             molecule_name=name,
             predictions=group,
@@ -308,7 +344,7 @@ def run_inference(
             outcome_counter=outcome,
         )
         if fastq is not None:
-          out_f.write(fastq)
+          emit(fastq, group)
           fastq_lines += 1
       t3 = time.time()
       timing_rows.extend([
@@ -328,6 +364,8 @@ def run_inference(
         flush_zmw_batch(zmw_batch)
         zmw_batch = []
     flush_zmw_batch(zmw_batch)
+  finally:
+    close_out()
 
   # Sidecar outputs (reference: quick_inference.py:777-791,961-962).
   with open(output + '.runtime.csv', 'w', newline='') as f:

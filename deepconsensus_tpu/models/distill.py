@@ -1,0 +1,171 @@
+"""Knowledge distillation: frozen teacher -> smaller student.
+
+Mirrors the reference's distillation trainer (reference:
+deepconsensus/models/model_distillation.py:104-420): the student is
+initialized from a teacher layer map, then trained with
+student_alpha * AlignmentLoss + distill_alpha * logit-space loss while
+the teacher runs inference-only. Both models share one jitted step.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import ml_collections
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import data as data_lib
+from deepconsensus_tpu.models import losses as losses_lib
+from deepconsensus_tpu.models import metrics as metrics_lib
+from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.models import train as train_lib
+
+
+def init_student_from_teacher(
+    student_params: Dict,
+    teacher_params: Dict,
+    cfg: ml_collections.ConfigDict,
+) -> Dict:
+  """Copies teacher weights into the student per the layer maps
+  (reference: model_distillation.py:104-144)."""
+  student = jax.tree_util.tree_map(lambda x: x, student_params)  # copy
+
+  if cfg.get('init_nonencoder_layers', True):
+    for key in student:
+      if key != 'encoder' and key in teacher_params:
+        student[key] = jax.tree_util.tree_map(
+            lambda x: x, teacher_params[key]
+        )
+
+  if cfg.get('init_encoder_stack', True):
+    t_layers = list(cfg.teacher_encoder_layers)
+    s_layers = list(cfg.student_encoder_layers)
+    enc_s = dict(student['encoder'])
+    enc_t = teacher_params['encoder']
+    for t, s in zip(t_layers, s_layers):
+      for stem in ('self_attention', 'attention_wrapper', 'ffn',
+                   'ffn_wrapper'):
+        src = f'{stem}_{t}'
+        dst = f'{stem}_{s}'
+        if src in enc_t and dst in enc_s:
+          enc_s[dst] = jax.tree_util.tree_map(lambda x: x, enc_t[src])
+    if 'output_normalization' in enc_t:
+      enc_s['output_normalization'] = jax.tree_util.tree_map(
+          lambda x: x, enc_t['output_normalization']
+      )
+    student['encoder'] = enc_s
+  return student
+
+
+def run_distillation(
+    params: ml_collections.ConfigDict,
+    teacher_params_cfg: ml_collections.ConfigDict,
+    teacher_variables: Dict,
+    out_dir: str,
+    train_patterns=None,
+    eval_patterns=None,
+    num_epochs: Optional[int] = None,
+    mesh=None,
+) -> Dict[str, float]:
+  """Distillation training driver; returns final eval metrics."""
+  train_patterns = train_patterns or list(params.train_path)
+  eval_patterns = eval_patterns or list(params.eval_path)
+  num_epochs = num_epochs or params.num_epochs
+
+  teacher_model = model_lib.get_model(teacher_params_cfg)
+  student_model = model_lib.get_model(params)
+
+  train_ds = data_lib.DatasetIterator(
+      patterns=train_patterns, params=params,
+      batch_size=params.batch_size, seed=params.seed,
+  )
+  eval_ds = data_lib.DatasetIterator(
+      patterns=eval_patterns, params=params,
+      batch_size=params.batch_size, shuffle=False,
+  )
+  decay_steps = train_ds.steps_per_epoch * params.get(
+      'num_epochs_for_decay', num_epochs
+  )
+  trainer = train_lib.Trainer(params=params, out_dir=out_dir, mesh=mesh)
+  config_lib.save_params_as_json(out_dir, params)
+  state = trainer.init_state(steps_total=max(decay_steps, 1))
+  state = state.replace(
+      params=init_student_from_teacher(
+          state.params, teacher_variables['params'], params
+      )
+  )
+
+  align_loss = train_lib.make_loss(params)
+  student_alpha = float(params.student_alpha)
+  distill_alpha = float(params.distill_alpha)
+  temperature = float(params.temperature)
+  logit_loss = params.get('logit_loss_identifier', 'mean_squared_error')
+
+  def step(state, batch):
+    rng = jax.random.fold_in(state.dropout_rng, state.step)
+    teacher_out = teacher_model.apply(
+        teacher_variables, batch['rows'],
+        method=teacher_model.apply_with_intermediates,
+    )
+
+    def loss_of(p):
+      out = student_model.apply(
+          {'params': p}, batch['rows'], train=True,
+          rngs={'dropout': rng},
+          method=student_model.apply_with_intermediates,
+      )
+      l_student = align_loss(batch['label'], out['preds'])
+      l_distill = losses_lib.distillation_loss(
+          teacher_out['logits'], out['logits'],
+          temperature=temperature, kind=logit_loss,
+      )
+      total = student_alpha * l_student + distill_alpha * l_distill
+      return total, (l_student, l_distill, out['preds'])
+
+    (loss, (l_s, l_d, preds)), grads = jax.value_and_grad(
+        loss_of, has_aux=True
+    )(state.params)
+    new_state = state.apply_gradients(grads=grads)
+    correct, total = metrics_lib.per_example_accuracy_counts(
+        batch['label'], preds
+    )
+    return new_state, {
+        'loss': loss,
+        'student_loss': l_s,
+        'distill_loss': l_d,
+        'accuracy_correct': correct,
+        'accuracy_total': total,
+    }
+
+  train_step = jax.jit(step, donate_argnums=(0,))
+  eval_step = trainer.eval_step_fn()
+
+  step_count = 0
+  final: Dict[str, float] = {}
+  for _ in range(num_epochs):
+    for batch in train_ds.epoch():
+      state, m = train_step(state, batch)
+      step_count += 1
+      if step_count % params.get('log_every_n_steps', 100) == 0:
+        trainer.log_metrics(
+            step_count, 'train', {k: float(v) for k, v in m.items()}
+        )
+  # Final eval + checkpoint.
+  sums: Dict[str, float] = {}
+  batches = 0
+  for batch in eval_ds.epoch():
+    out = {k: float(v) for k, v in eval_step(state, batch).items()}
+    for k, v in out.items():
+      sums[k] = sums.get(k, 0.0) + v
+    batches += 1
+  if batches:
+    final = {
+        'eval/loss': sums['loss'] / batches,
+        'eval/per_example_accuracy': (
+            sums['accuracy_correct'] / max(sums['accuracy_total'], 1)
+        ),
+    }
+  trainer.save_checkpoint(state, step_count, final)
+  return final

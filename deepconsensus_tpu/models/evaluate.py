@@ -1,0 +1,114 @@
+"""Offline evaluation over labeled TFRecords -> inference.csv.
+
+Equivalent of the reference's model_inference binary (reference:
+deepconsensus/models/model_inference.py:79-137,
+model_utils.py:379-421): restores a checkpoint, sweeps the eval set,
+and writes one CSV row of metrics.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import ml_collections
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.models import data as data_lib
+from deepconsensus_tpu.models import metrics as metrics_lib
+from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.models import train as train_lib
+
+
+def run_evaluation(
+    params: ml_collections.ConfigDict,
+    checkpoint_path: Optional[str],
+    eval_patterns,
+    out_dir: str,
+    variables: Optional[Dict] = None,
+    limit: int = -1,
+) -> Dict[str, float]:
+  """Evaluates and writes <out_dir>/inference.csv; returns metrics."""
+  model = model_lib.get_model(params)
+  if variables is None:
+    import orbax.checkpoint as ocp
+
+    rows = jnp.zeros(
+        (1, params.total_rows, params.max_length, 1), jnp.float32
+    )
+    init_vars = model.init(jax.random.PRNGKey(0), rows)
+    checkpointer = ocp.StandardCheckpointer()
+    restored = checkpointer.restore(
+        os.path.abspath(checkpoint_path),
+        target={'params': jax.device_get(init_vars['params']), 'step': 0},
+    )
+    variables = {'params': restored['params']}
+
+  loss_obj = train_lib.make_loss(params)
+  align_metric = metrics_lib.AlignmentMetric()
+
+  @jax.jit
+  def eval_step(batch):
+    preds = model.apply(variables, batch['rows'])
+    loss = loss_obj(batch['label'], preds)
+    correct, total = metrics_lib.per_example_accuracy_counts(
+        batch['label'], preds
+    )
+    ccs = train_lib.ccs_row_from_batch(batch['rows'], params)
+    id_ccs, id_pred = metrics_lib.batch_identity_ccs_pred(
+        ccs, preds, batch['label'], align_metric
+    )
+    out = {
+        'loss': loss,
+        'accuracy_correct': correct,
+        'accuracy_total': total,
+        'identity_ccs': id_ccs,
+        'identity_pred': id_pred,
+    }
+    for cls in range(constants.SEQ_VOCAB_SIZE):
+      c, t = metrics_lib.per_class_accuracy_counts(
+          batch['label'], preds, cls
+      )
+      out[f'class{cls}_correct'] = c
+      out[f'class{cls}_total'] = t
+    return out
+
+  ds = data_lib.DatasetIterator(
+      patterns=eval_patterns,
+      params=params,
+      batch_size=params.batch_size,
+      shuffle=False,
+      limit=limit,
+  )
+  sums: Dict[str, float] = {}
+  batches = 0
+  yield_metric = metrics_lib.YieldOverCCS()
+  for batch in ds.epoch():
+    out = {k: float(v) for k, v in eval_step(batch).items()}
+    yield_metric.update(out['identity_ccs'], out['identity_pred'])
+    for k, v in out.items():
+      sums[k] = sums.get(k, 0.0) + v
+    batches += 1
+  metrics = {
+      'loss': sums['loss'] / batches,
+      'per_example_accuracy': (
+          sums['accuracy_correct'] / max(sums['accuracy_total'], 1)
+      ),
+      'alignment_identity': sums['identity_pred'] / batches,
+      'ccs_identity': sums['identity_ccs'] / batches,
+      'yield_over_ccs': yield_metric.result(),
+  }
+  for cls in range(constants.SEQ_VOCAB_SIZE):
+    total = sums.get(f'class{cls}_total', 0.0)
+    if total:
+      metrics[f'class{cls}_accuracy'] = sums[f'class{cls}_correct'] / total
+
+  os.makedirs(out_dir, exist_ok=True)
+  csv_path = os.path.join(out_dir, 'inference.csv')
+  with open(csv_path, 'w', newline='') as f:
+    writer = csv.writer(f)
+    writer.writerow(sorted(metrics))
+    writer.writerow([metrics[k] for k in sorted(metrics)])
+  return metrics

@@ -1,0 +1,76 @@
+"""Deployable model export via jax.export (StableHLO).
+
+Equivalent of the reference's checkpoint->SavedModel conversion
+(reference: deepconsensus/models/convert_to_saved_model.py:67-105):
+bakes restored parameters into a fixed-batch serving function, exports
+it as portable StableHLO bytes, and copies params.json alongside. The
+artifact reloads without any model code, like a SavedModel signature.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+import ml_collections
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+
+ARTIFACT_NAME = 'serving.stablehlo'
+
+
+def export_model(
+    checkpoint_path: str,
+    out_dir: str,
+    batch_size: int = 1024,
+    variables: Optional[Dict] = None,
+    params: Optional[ml_collections.ConfigDict] = None,
+) -> str:
+  """Exports a serving function rows->softmax; returns artifact path."""
+  if params is None:
+    params = config_lib.read_params_from_json(checkpoint_path)
+    config_lib.finalize_params(params, is_training=False)
+  model = model_lib.get_model(params)
+  rows_shape = (batch_size, params.total_rows, params.max_length, 1)
+
+  if variables is None:
+    import orbax.checkpoint as ocp
+
+    init_vars = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1,) + rows_shape[1:])
+    )
+    checkpointer = ocp.StandardCheckpointer()
+    restored = checkpointer.restore(
+        os.path.abspath(checkpoint_path),
+        target={'params': jax.device_get(init_vars['params']), 'step': 0},
+    )
+    variables = {'params': restored['params']}
+
+  def serving_fn(rows):
+    return model.apply(variables, rows)
+
+  exported = jax_export.export(jax.jit(serving_fn))(
+      jax.ShapeDtypeStruct(rows_shape, jnp.float32)
+  )
+  os.makedirs(out_dir, exist_ok=True)
+  artifact = os.path.join(out_dir, ARTIFACT_NAME)
+  with open(artifact, 'wb') as f:
+    f.write(exported.serialize())
+  config_lib.save_params_as_json(out_dir, params)
+  with open(os.path.join(out_dir, 'export_meta.json'), 'w') as f:
+    json.dump({'batch_size': batch_size, 'rows_shape': rows_shape}, f)
+  return artifact
+
+
+def load_exported(out_dir: str) -> Tuple[Callable, Dict]:
+  """Loads an exported artifact; returns (callable, meta)."""
+  with open(os.path.join(out_dir, ARTIFACT_NAME), 'rb') as f:
+    exported = jax_export.deserialize(f.read())
+  with open(os.path.join(out_dir, 'export_meta.json')) as f:
+    meta = json.load(f)
+  return exported.call, meta

@@ -178,12 +178,20 @@ class Trainer:
       }
       return new_state, metrics
 
-    batch_sh = mesh_lib.batch_sharding(self.mesh)
+    batch_sh = self._batch_sharding()
     return jax.jit(
         step,
         in_shardings=(None, {'rows': batch_sh, 'label': batch_sh}),
         donate_argnums=(0,),
     )
+
+  def _batch_sharding(self):
+    """Shard the batch over the data axis when divisible, else
+    replicate (tiny test batches)."""
+    dp = self.mesh.shape[mesh_lib.DATA_AXIS]
+    if self.params.batch_size % dp == 0:
+      return mesh_lib.batch_sharding(self.mesh)
+    return mesh_lib.replicated(self.mesh)
 
   def eval_step_fn(self):
     loss_obj = self.loss_fn
@@ -215,7 +223,7 @@ class Trainer:
         out[f'class{cls}_total'] = t
       return out
 
-    batch_sh = mesh_lib.batch_sharding(self.mesh)
+    batch_sh = self._batch_sharding()
     return jax.jit(
         step, in_shardings=(None, {'rows': batch_sh, 'label': batch_sh})
     )

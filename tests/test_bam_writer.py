@@ -1,0 +1,42 @@
+"""BAM writer roundtrip tests through our own reader."""
+import numpy as np
+
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.io.bam_writer import BamWriter
+
+
+def test_bam_roundtrip(tmp_path):
+  path = str(tmp_path / 'out.bam')
+  quals = np.array([0, 10, 40, 93], dtype=np.uint8)
+  with BamWriter(path, header_text='@HD\tVN:1.5\n') as w:
+    w.write(
+        'm0/42/ccs', 'ACGT', quals,
+        tags={'ec': 11.5, 'np': 7, 'rq': 0.999, 'RG': 'group1', 'zm': 42},
+    )
+    w.write('m0/43/ccs', 'TTT', None, tags={'zm': 43})
+  reader = bam_lib.BamReader(path)
+  assert '@HD' in reader.header_text
+  records = list(reader)
+  assert len(records) == 2
+  rec = records[0]
+  assert rec.qname == 'm0/42/ccs'
+  assert rec.seq == 'ACGT'
+  assert rec.is_unmapped
+  np.testing.assert_array_equal(rec.quals, quals)
+  assert rec.get_tag('ec') == 11.5
+  assert rec.get_tag('np') == 7
+  assert abs(rec.get_tag('rq') - 0.999) < 1e-6
+  assert rec.get_tag('RG') == 'group1'
+  assert rec.get_tag('zm') == 42
+  assert records[1].quals is None
+
+
+def test_bam_large_block(tmp_path):
+  """Payload larger than one BGZF block still roundtrips."""
+  path = str(tmp_path / 'big.bam')
+  seq = 'ACGT' * 30000  # 120 kb > 64 KiB BGZF block
+  with BamWriter(path) as w:
+    w.write('m0/1/ccs', seq, np.full(len(seq), 30, np.uint8), tags={'zm': 1})
+  rec = next(iter(bam_lib.BamReader(path)))
+  assert rec.seq == seq
+  assert len(rec.quals) == len(seq)

@@ -1,0 +1,124 @@
+"""Distillation, offline evaluation, and export round-trips."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.models import (
+    config as config_lib,
+    distill as distill_lib,
+    evaluate as evaluate_lib,
+    export as export_lib,
+    model as model_lib,
+)
+
+
+def _params(name='transformer_learn_values+test', layers=2, **kw):
+  params = config_lib.get_config(name)
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = layers
+    params.filter_size = 64
+    params.batch_size = 4
+    for k, v in kw.items():
+      params[k] = v
+  return params
+
+
+def test_init_student_from_teacher():
+  teacher_cfg = _params(layers=2)
+  student_cfg = _params('transformer_learn_values_distill+test', layers=1)
+  with student_cfg.unlocked():
+    student_cfg.teacher_encoder_layers = [1]
+    student_cfg.student_encoder_layers = [0]
+    student_cfg.filter_size = 64
+  rows = jnp.zeros((1, teacher_cfg.total_rows, 100, 1))
+  teacher = model_lib.get_model(teacher_cfg)
+  student = model_lib.get_model(student_cfg)
+  t_vars = teacher.init(jax.random.PRNGKey(0), rows)
+  s_vars = student.init(jax.random.PRNGKey(1), rows)
+  merged = distill_lib.init_student_from_teacher(
+      s_vars['params'], t_vars['params'], student_cfg
+  )
+  # Student layer 0 == teacher layer 1 weights.
+  np.testing.assert_array_equal(
+      np.asarray(
+          merged['encoder']['self_attention_0']['query']['kernel']
+      ),
+      np.asarray(
+          t_vars['params']['encoder']['self_attention_1']['query']['kernel']
+      ),
+  )
+  # Non-encoder layers copied too.
+  np.testing.assert_array_equal(
+      np.asarray(merged['bases_embedding']['embedding']),
+      np.asarray(t_vars['params']['bases_embedding']['embedding']),
+  )
+
+
+def test_distillation_smoke(tmp_path, testdata_dir):
+  teacher_cfg = _params(layers=2)
+  teacher = model_lib.get_model(teacher_cfg)
+  rows = jnp.zeros((1, teacher_cfg.total_rows, 100, 1))
+  t_vars = teacher.init(jax.random.PRNGKey(0), rows)
+
+  student_cfg = _params('transformer_learn_values_distill+test', layers=1)
+  with student_cfg.unlocked():
+    student_cfg.teacher_encoder_layers = [1]
+    student_cfg.student_encoder_layers = [0]
+    student_cfg.filter_size = 64
+    student_cfg.num_epochs = 1
+  metrics = distill_lib.run_distillation(
+      params=student_cfg,
+      teacher_params_cfg=teacher_cfg,
+      teacher_variables=t_vars,
+      out_dir=str(tmp_path / 'distill'),
+      train_patterns=[str(testdata_dir / 'human_1m/tf_examples/train/*')],
+      eval_patterns=[str(testdata_dir / 'human_1m/tf_examples/eval/*')],
+      num_epochs=1,
+  )
+  assert np.isfinite(metrics['eval/loss'])
+
+
+def test_evaluation_writes_csv(tmp_path, testdata_dir):
+  params = _params(layers=1)
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, 100, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  metrics = evaluate_lib.run_evaluation(
+      params=params,
+      checkpoint_path=None,
+      eval_patterns=[str(testdata_dir / 'human_1m/tf_examples/eval/*')],
+      out_dir=str(tmp_path / 'eval'),
+      variables=variables,
+  )
+  assert os.path.exists(tmp_path / 'eval' / 'inference.csv')
+  assert 0.0 <= metrics['per_example_accuracy'] <= 1.0
+  assert np.isfinite(metrics['loss'])
+  # An untrained model should not beat CCS identity.
+  assert metrics['ccs_identity'] > metrics['alignment_identity']
+
+
+def test_export_roundtrip(tmp_path):
+  params = _params(layers=1)
+  model = model_lib.get_model(params)
+  rows_np = np.zeros((4, params.total_rows, 100, 1), np.float32)
+  variables = model.init(jax.random.PRNGKey(0), jnp.asarray(rows_np))
+  out_dir = str(tmp_path / 'export')
+  export_lib.export_model(
+      checkpoint_path=out_dir,  # unused when variables given
+      out_dir=out_dir,
+      batch_size=4,
+      variables=variables,
+      params=params,
+  )
+  serving, meta = export_lib.load_exported(out_dir)
+  assert meta['batch_size'] == 4
+  preds = serving(jnp.asarray(rows_np))
+  direct = model.apply(variables, jnp.asarray(rows_np))
+  np.testing.assert_allclose(
+      np.asarray(preds), np.asarray(direct), atol=1e-5
+  )
