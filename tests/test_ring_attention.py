@@ -1,0 +1,46 @@
+"""Ring attention vs single-device reference on an 8-way virtual mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deepconsensus_tpu.parallel import ring_attention as ra
+
+
+def make_qkv(b=2, l=64, h=2, d=8, seed=0):
+  rng = np.random.default_rng(seed)
+  mk = lambda: jnp.asarray(
+      rng.normal(size=(b, l, h, d)).astype(np.float32)
+  )
+  return mk(), mk(), mk()
+
+
+@pytest.fixture
+def seq_mesh():
+  devices = np.array(jax.devices()[:8]).reshape(8)
+  return Mesh(devices, ('seq',))
+
+
+def test_ring_matches_full(seq_mesh):
+  q, k, v = make_qkv()
+  want = ra.full_attention_reference(q, k, v)
+  got = ra.ring_attention_sharded(q, k, v, seq_mesh, 'seq')
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ring_banded_matches_full(seq_mesh):
+  q, k, v = make_qkv(seed=1)
+  want = ra.full_attention_reference(q, k, v, attn_win_size=12)
+  got = ra.ring_attention_sharded(q, k, v, seq_mesh, 'seq',
+                                  attn_win_size=12)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ring_long_sequence(seq_mesh):
+  # A sequence far longer than any single window, banded like the model.
+  q, k, v = make_qkv(b=1, l=1024, h=2, d=8, seed=2)
+  want = ra.full_attention_reference(q, k, v, attn_win_size=32)
+  got = ra.ring_attention_sharded(q, k, v, seq_mesh, 'seq',
+                                  attn_win_size=32)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
