@@ -120,7 +120,6 @@ def ring_attention_sharded(
       mesh=mesh,
       in_specs=(spec, spec, spec),
       out_specs=spec,
-      check_rep=False,
   )(q, k, v)
 
 
