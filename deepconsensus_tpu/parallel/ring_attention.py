@@ -68,10 +68,17 @@ def ring_attention(
 
   q_offset = my_index * l_local
 
-  # Online softmax state.
-  m = jnp.full((b, h, l_local), _NEG_INF, q.dtype)  # running max
-  l_sum = jnp.zeros((b, h, l_local), q.dtype)  # running denominator
-  o = jnp.zeros((b, l_local, h, d), q.dtype)  # running numerator
+  # Online softmax state; pvary marks the zeros as device-varying so
+  # the scan carry types line up with the ppermuted K/V.
+  m = jax.lax.pvary(
+      jnp.full((b, h, l_local), _NEG_INF, q.dtype), axis_name
+  )  # running max
+  l_sum = jax.lax.pvary(
+      jnp.zeros((b, h, l_local), q.dtype), axis_name
+  )  # running denominator
+  o = jax.lax.pvary(
+      jnp.zeros((b, l_local, h, d), q.dtype), axis_name
+  )  # running numerator
 
   perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
