@@ -146,7 +146,7 @@ def preprocess_zmw(
   (reference: quick_inference.py:535-564)."""
   subreads, name, layout, _split, window_widths = zmw_input
   pileup = reads_to_pileup(subreads, name, layout, window_widths)
-  features = [w.to_features_dict() for w in pileup.iter_windows()]
+  features = list(pileup.iter_window_features())
   return features, pileup.counter
 
 

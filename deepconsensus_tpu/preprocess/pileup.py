@@ -282,6 +282,91 @@ class Pileup:
       )
     return data[:, :, None]
 
+  def full_matrix(self) -> np.ndarray:
+    """Whole-ZMW stacked feature matrix [tensor_height, width].
+
+    Windows are column slices of this matrix (plus padding rules), so
+    building it once replaces per-window re-stacking.
+    """
+    return self.extract_features()[:, :, 0]
+
+  def iter_window_features(self) -> Iterator[Dict[str, Any]]:
+    """Fast inference path: window feature dicts via slices of the
+    whole-ZMW matrix. Produces dicts identical to
+    iter_windows()+to_features_dict() for inference pileups.
+    """
+    assert not self.is_training, 'fast path is inference-only'
+    self.counter = Counter()
+    layout = self.layout
+    max_length = layout.max_length
+    matrix = self.full_matrix()
+    ccs = self.ccs
+    ccs_idx = ccs.ccs_idx
+    bq = ccs.base_quality_scores
+    has_bq = bq.size == len(ccs.bases)  # spaced alongside the pileup
+
+    n_subreads = self.n_subreads
+    keep = self.subreads[: layout.max_passes]
+    strand_rows = layout.indices('strand', n_subreads)
+    sn_rows = layout.indices('sn')
+    strand_col = np.array(
+        [float(int(r.strand)) for r in keep], dtype=constants.NP_DATA_TYPE
+    )
+    sn_col = (
+        np.asarray(self.subreads[0].sn, dtype=constants.NP_DATA_TYPE)
+        if self.subreads else np.zeros(4, dtype=constants.NP_DATA_TYPE)
+    )
+
+    start = 0
+    for window_width in self.calculate_windows(max_length):
+      self.counter[f'example_width_bucket_{window_width}'] += 1
+      if start > self.ccs_width:
+        break
+      sl = slice(start, start + window_width)
+      start += window_width
+      idx_slice = ccs_idx[sl]
+      covered = idx_slice[idx_slice >= 0]
+      if covered.size == 0:
+        self.counter['n_examples_no_ccs_idx'] += 1
+        continue
+      overflow = window_width > max_length
+      if overflow:
+        self.counter['n_examples_overflow'] += 1
+      else:
+        self.counter['n_examples_skip_large_windows_keep'] += 1
+
+      width = max(window_width, max_length)
+      chunk = matrix[:, sl]
+      if chunk.shape[1] < width:
+        data = np.zeros(
+            (layout.tensor_height, width), dtype=constants.NP_DATA_TYPE
+        )
+        data[:, : chunk.shape[1]] = chunk
+        # Padding rules: strand/sn rows repeat across the pad; ccs_bq
+        # pads with -1 (see extract_features + AlignedRead.pad).
+        data[strand_rows, chunk.shape[1] :] = strand_col[:, None]
+        data[sn_rows, chunk.shape[1] :] = sn_col[:, None]
+        if layout.use_ccs_bq:
+          data[layout.indices('ccs_bq'), chunk.shape[1] :] = -1
+      else:
+        data = np.ascontiguousarray(chunk)
+
+      window_bq = np.full(width, -1, dtype=np.int64)
+      if has_bq:
+        window_bq[: min(len(bq[sl]), width)] = bq[sl][:width]
+      yield {
+          'subreads': data[:, :, None],
+          'subreads/num_passes': self.keep_subreads,
+          'name': self.name,
+          'window_pos': int(covered.min()),
+          'ccs_base_quality_scores': window_bq,
+          'overflow': overflow,
+          'ec': ccs.ec,
+          'np_num_passes': ccs.np_num_passes,
+          'rq': ccs.rq,
+          'rg': ccs.rg,
+      }
+
   def to_features_dict(self) -> Dict[str, Any]:
     """Feature dict for the in-memory inference path
     (reference: pre_lib.py:746-762)."""

@@ -1,0 +1,42 @@
+"""The fast whole-ZMW window path must equal the per-window slow path."""
+import numpy as np
+
+from deepconsensus_tpu.preprocess import (
+    FeatureLayout,
+    create_proc_feeder,
+    reads_to_pileup,
+)
+
+TDKEYS = ('subreads/num_passes', 'name', 'window_pos', 'overflow',
+          'ec', 'np_num_passes', 'rq', 'rg')
+
+
+def test_fast_path_equals_slow_path(testdata_dir):
+  td = str(testdata_dir / 'human_1m')
+  layout = FeatureLayout(20, 100)
+  feeder, _ = create_proc_feeder(
+      subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+      ccs_bam=f'{td}/ccs.bam',
+      layout=layout,
+      ins_trim=5,
+  )
+  n_windows = 0
+  for subreads, name, lay, split, ww in feeder():
+    slow_pileup = reads_to_pileup(subreads, name, lay, ww)
+    slow = [w.to_features_dict() for w in slow_pileup.iter_windows()]
+    slow_counter = dict(slow_pileup.counter)
+    fast_pileup = reads_to_pileup(subreads, name, lay, ww)
+    fast = list(fast_pileup.iter_window_features())
+    assert dict(fast_pileup.counter) == slow_counter
+    assert len(fast) == len(slow)
+    for f, s in zip(fast, slow):
+      for key in TDKEYS:
+        assert f[key] == s[key], key
+      np.testing.assert_array_equal(
+          f['subreads'], s['subreads'], err_msg=str((name, s['window_pos']))
+      )
+      np.testing.assert_array_equal(
+          f['ccs_base_quality_scores'], s['ccs_base_quality_scores']
+      )
+      n_windows += 1
+  assert n_windows > 1500
