@@ -230,11 +230,35 @@ def parse_record(data: bytes, references: List[str]) -> BamRecord:
 
 
 class BamReader:
-  """Streams records from a BAM file in file order."""
+  """Streams records from a BAM file in file order.
 
-  def __init__(self, path: str):
+  When the native library is available and the file is modest, BGZF
+  blocks decompress in parallel in C++ (htslib-style); otherwise the
+  gzip module streams the concatenated members.
+  """
+
+  NATIVE_MAX_BYTES = 4 << 30
+
+  def __init__(self, path: str, use_native: bool = True,
+               native_threads: int = 4):
     self.path = path
-    self._f = gzip.open(path, 'rb')
+    self._f = None
+    if use_native:
+      try:
+        import os
+
+        from deepconsensus_tpu import native
+
+        if os.path.getsize(path) <= self.NATIVE_MAX_BYTES:
+          data = native.bgzf_decompress_file(path, native_threads)
+          if data is not None:
+            import io
+
+            self._f = io.BytesIO(data)
+      except Exception:  # pragma: no cover - fallback path
+        self._f = None
+    if self._f is None:
+      self._f = gzip.open(path, 'rb')
     magic = self._f.read(4)
     if magic != b'BAM\x01':
       raise IOError(f'{path} is not a BAM file (magic={magic!r})')

@@ -31,12 +31,24 @@ def _build_table() -> None:
 _build_table()
 
 
-def crc32c(data: bytes, value: int = 0) -> int:
+def _crc32c_py(data: bytes, value: int = 0) -> int:
   crc = value ^ 0xFFFFFFFF
   table = _CRC_TABLE
   for b in data:
     crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
   return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes, value: int = 0) -> int:
+  try:
+    from deepconsensus_tpu import native
+
+    result = native.crc32c(data, value)
+    if result is not None:
+      return result
+  except Exception:  # pragma: no cover
+    pass
+  return _crc32c_py(data, value)
 
 
 def _masked_crc(data: bytes) -> int:

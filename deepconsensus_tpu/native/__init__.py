@@ -1,0 +1,101 @@
+"""Native (C++) accelerators with build-on-first-use and ctypes bindings.
+
+The reference's native surface is htslib via pysam; here the equivalent
+is a small C++ library (bgzf.cpp) compiled on demand with the system
+toolchain. Everything degrades gracefully to the pure-Python paths when
+a compiler is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, 'bgzf.cpp')
+_LIB = os.path.join(_DIR, 'libdcnative.so')
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+  cmd = [
+      'g++', '-O3', '-shared', '-fPIC', '-std=c++17', _SRC,
+      '-o', _LIB, '-lz', '-lpthread',
+  ]
+  try:
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    return True
+  except (subprocess.CalledProcessError, FileNotFoundError,
+          subprocess.TimeoutExpired) as e:
+    log.warning('native build failed (%s); using pure-Python fallback', e)
+    return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+  """Loads (building if needed) the native library, or None."""
+  global _lib, _build_failed
+  with _lock:
+    if _lib is not None:
+      return _lib
+    if _build_failed:
+      return None
+    if not os.path.exists(_LIB) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    ):
+      if not _build():
+        _build_failed = True
+        return None
+    try:
+      lib = ctypes.CDLL(_LIB)
+    except OSError as e:
+      log.warning('native load failed (%s)', e)
+      _build_failed = True
+      return None
+    lib.dc_bgzf_decompress_file.restype = ctypes.c_int
+    lib.dc_bgzf_decompress_file.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_size_t),
+    ]
+    lib.dc_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.dc_crc32c.restype = ctypes.c_uint32
+    lib.dc_crc32c.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint32
+    ]
+    _lib = lib
+    return _lib
+
+
+def bgzf_decompress_file(path: str, n_threads: int = 4) -> Optional[bytes]:
+  """Decompresses a whole BGZF file in parallel; None -> use fallback."""
+  lib = get_lib()
+  if lib is None:
+    return None
+  out = ctypes.POINTER(ctypes.c_uint8)()
+  out_len = ctypes.c_size_t()
+  rc = lib.dc_bgzf_decompress_file(
+      path.encode(), n_threads, ctypes.byref(out), ctypes.byref(out_len)
+  )
+  if rc != 0:
+    return None
+  try:
+    return ctypes.string_at(out, out_len.value)
+  finally:
+    lib.dc_free(out)
+
+
+def crc32c(data: bytes, seed: int = 0) -> Optional[int]:
+  lib = get_lib()
+  if lib is None:
+    return None
+  return int(lib.dc_crc32c(data, len(data), seed))
