@@ -38,7 +38,8 @@ class BgzfWriter:
   def _flush_block(self, payload: bytes) -> None:
     compressor = zlib.compressobj(6, zlib.DEFLATED, -15)
     comp = compressor.compress(payload) + compressor.flush()
-    bsize = len(comp) + 25 + 1  # header(18) + footer(8) - 1
+    # BSIZE field = total block size - 1; total = 18 header + comp + 8.
+    bsize = len(comp) + 25
     block = (
         b'\x1f\x8b\x08\x04\x00\x00\x00\x00\x00\xff'
         + struct.pack('<HHHH', 6, 0x4342, 2, bsize)
