@@ -77,6 +77,7 @@ class BandedSelfAttention(nn.Module):
   dropout_rate: float
   attn_win_size: Optional[int]
   dtype: Any = jnp.float32
+  use_pallas: bool = False
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
@@ -95,20 +96,28 @@ class BandedSelfAttention(nn.Module):
     key = dense('key')(x)
     value = dense('value')(x)
 
-    # [B, N, Lq, Lk]
-    logits = jnp.einsum('BTNH,BFNH->BNFT', key, query)
-    length = x.shape[1]
-    if self.attn_win_size:
-      i = np.arange(length)
-      band = np.abs(i[:, None] - i[None, :]) <= self.attn_win_size
-      logits = jnp.where(band[None, None, :, :], logits, -1e9)
-    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
-        self.dtype
-    )
-    weights = nn.Dropout(rate=self.dropout_rate)(
-        weights, deterministic=deterministic
-    )
-    out = jnp.einsum('BNFT,BTNH->BFNH', weights, value)
+    if self.use_pallas and deterministic:
+      # Fused VMEM kernel (no attention dropout path).
+      from deepconsensus_tpu.ops import banded_attention as ba
+
+      out = ba.banded_attention(
+          query, key, value, self.attn_win_size or None
+      )
+    else:
+      # [B, N, Lq, Lk]
+      logits = jnp.einsum('BTNH,BFNH->BNFT', key, query)
+      length = x.shape[1]
+      if self.attn_win_size:
+        i = np.arange(length)
+        band = np.abs(i[:, None] - i[None, :]) <= self.attn_win_size
+        logits = jnp.where(band[None, None, :, :], logits, -1e9)
+      weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+          self.dtype
+      )
+      weights = nn.Dropout(rate=self.dropout_rate)(
+          weights, deterministic=deterministic
+      )
+      out = jnp.einsum('BNFT,BTNH->BFNH', weights, value)
     return nn.DenseGeneral(
         features=self.hidden_size,
         axis=(-2, -1),
@@ -174,6 +183,7 @@ class EncoderStack(nn.Module):
           dropout_rate=p.attention_dropout,
           attn_win_size=p.attn_win_size,
           dtype=self.dtype,
+          use_pallas=p.get('use_pallas_attention', False),
           name=f'self_attention_{n}',
       )
       x = ResidualWrapper(
