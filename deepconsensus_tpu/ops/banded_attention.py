@@ -42,26 +42,28 @@ def reference_banded_attention(
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, *, attn_win_size, length):
-  # Blocks are [1, L, D] for one (batch, head) program.
-  q = q_ref[0].astype(jnp.float32)
-  k = k_ref[0].astype(jnp.float32)
-  v = v_ref[0].astype(jnp.float32)
+  # Blocks are [G, L, D]: G (batch*head) pairs per program.
+  q = q_ref[:].astype(jnp.float32)
+  k = k_ref[:].astype(jnp.float32)
+  v = v_ref[:].astype(jnp.float32)
   s = jax.lax.dot_general(
-      q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-  )  # [L, L]
-  rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-  cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+      q, k, (((2,), (2,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  )  # [G, L, L]
+  rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+  cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
   valid = cols < length
   if attn_win_size is not None:
     valid = valid & (jnp.abs(rows - cols) <= attn_win_size)
   s = jnp.where(valid, s, _NEG)
-  m = jnp.max(s, axis=1, keepdims=True)
+  m = jnp.max(s, axis=2, keepdims=True)
   p = jnp.exp(s - m)
-  denom = jnp.sum(p, axis=1, keepdims=True)
+  denom = jnp.sum(p, axis=2, keepdims=True)
   o = jax.lax.dot_general(
-      p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+      p, v, (((2,), (1,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
   )
-  o_ref[0] = (o / denom).astype(o_ref.dtype)
+  o_ref[:] = (o / denom).astype(o_ref.dtype)
 
 
 def banded_attention(
@@ -70,28 +72,28 @@ def banded_attention(
     v: Array,
     attn_win_size: Optional[int],
     interpret: bool = False,
+    group: int = 16,
 ) -> Array:
   """Fused banded attention. q,k,v: [B, L, H, D], q pre-scaled."""
   b, l, h, d = q.shape
+  n = b * h
+  group = min(group, n)
+  while n % group:
+    group -= 1
+
   # [B, L, H, D] -> [B*H, L, D] program blocks.
   def to_blocks(x):
-    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, l, d)
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(n, l, d)
 
   qb, kb, vb = to_blocks(q), to_blocks(k), to_blocks(v)
+  spec = pl.BlockSpec((group, l, d), lambda i: (i, 0, 0),
+                      memory_space=pltpu.VMEM)
   out = pl.pallas_call(
       functools.partial(_kernel, attn_win_size=attn_win_size, length=l),
-      grid=(b * h,),
-      in_specs=[
-          pl.BlockSpec((1, l, d), lambda i: (i, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, l, d), lambda i: (i, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, l, d), lambda i: (i, 0, 0),
-                       memory_space=pltpu.VMEM),
-      ],
-      out_specs=pl.BlockSpec((1, l, d), lambda i: (i, 0, 0),
-                             memory_space=pltpu.VMEM),
-      out_shape=jax.ShapeDtypeStruct((b * h, l, d), q.dtype),
+      grid=(n // group,),
+      in_specs=[spec, spec, spec],
+      out_specs=spec,
+      out_shape=jax.ShapeDtypeStruct((n, l, d), q.dtype),
       interpret=interpret,
   )(qb, kb, vb)
   return jnp.transpose(out.reshape(b, h, l, d), (0, 2, 1, 3))
