@@ -61,6 +61,16 @@ def _add_train(sub):
                  help='Tensor-parallel mesh size.')
 
 
+def _add_distill(sub):
+  p = sub.add_parser('distill', help='Distill a teacher into a student.')
+  p.add_argument('--teacher_checkpoint', required=True)
+  p.add_argument('--config', default='transformer_learn_values_distill+test')
+  p.add_argument('--out_dir', required=True)
+  p.add_argument('--train_path', nargs='*')
+  p.add_argument('--eval_path', nargs='*')
+  p.add_argument('--num_epochs', type=int)
+
+
 def _add_calibrate(sub):
   p = sub.add_parser(
       'calibrate', help='Measure empirical base-quality calibration.')
@@ -88,6 +98,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_preprocess(sub)
   _add_run(sub)
   _add_train(sub)
+  _add_distill(sub)
   _add_calibrate(sub)
   _add_filter_reads(sub)
   return parser
@@ -170,6 +181,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         num_epochs=args.num_epochs,
         mesh=mesh,
         warm_start=args.checkpoint,
+    )
+    return 0
+
+  if args.command == 'distill':
+    import jax
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    import os as os_mod
+
+    from deepconsensus_tpu.models import config as config_lib
+    from deepconsensus_tpu.models import distill as distill_lib
+    from deepconsensus_tpu.models import model as model_lib
+
+    teacher_params = config_lib.read_params_from_json(
+        args.teacher_checkpoint
+    )
+    config_lib.finalize_params(teacher_params)
+    teacher = model_lib.get_model(teacher_params)
+    rows = jnp.zeros(
+        (1, teacher_params.total_rows, teacher_params.max_length, 1)
+    )
+    init_vars = teacher.init(jax.random.PRNGKey(0), rows)
+    restored = ocp.StandardCheckpointer().restore(
+        os_mod.path.abspath(args.teacher_checkpoint),
+        target={'params': jax.device_get(init_vars['params']), 'step': 0},
+    )
+    student_params = config_lib.get_config(args.config)
+    config_lib.finalize_params(student_params)
+    distill_lib.run_distillation(
+        params=student_params,
+        teacher_params_cfg=teacher_params,
+        teacher_variables={'params': restored['params']},
+        out_dir=args.out_dir,
+        train_patterns=args.train_path,
+        eval_patterns=args.eval_path,
+        num_epochs=args.num_epochs,
     )
     return 0
 

@@ -63,6 +63,9 @@ class InferenceOptions:
   use_ccs_smart_windows: bool = False
   max_base_quality: int = 93
   limit: int = 0
+  cpus: int = 0  # >0: featurization worker pool
+  # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
+  end_after_stage: str = 'full'  # dc_input | tf_examples | run_model | full
   dc_calibration_values: calibration_lib.QualityCalibrationValues = (
       dataclasses.field(
           default_factory=lambda: calibration_lib.parse_calibration_string(
@@ -237,11 +240,12 @@ def run_model_on_windows(
 
 def run_inference(
     subreads_to_ccs: str,
-    ccs_bam: str,
+    ccs_bam: Optional[str],
     checkpoint: Optional[str],
     output: str,
     options: Optional[InferenceOptions] = None,
     runner: Optional[ModelRunner] = None,
+    ccs_fasta: Optional[str] = None,
 ) -> Dict[str, Any]:
   """Full inference pipeline; returns the counters dict
   (reference run(): quick_inference.py:794-963)."""
@@ -263,11 +267,17 @@ def run_inference(
   feeder, counter = create_proc_feeder(
       subreads_to_ccs=subreads_to_ccs,
       ccs_bam=ccs_bam,
+      ccs_fasta=ccs_fasta,
       layout=layout,
       ins_trim=options.ins_trim,
       use_ccs_smart_windows=options.use_ccs_smart_windows,
       limit=options.limit,
   )
+  pool = None
+  if options.cpus and options.cpus > 1:
+    import multiprocessing
+
+    pool = multiprocessing.Pool(options.cpus)
   outcome = stitch.OutcomeCounter()
   timing_rows: List[Dict[str, Any]] = []
   fastq_lines = 0
@@ -316,12 +326,25 @@ def run_inference(
       t0 = time.time()
       all_windows: List[Dict[str, Any]] = []
       n_subreads = 0
-      for zmw_input in zmw_batch:
+      if options.end_after_stage == 'dc_input':
+        return
+      if pool is not None:
+        results = pool.starmap(
+            preprocess_zmw, [(z, options) for z in zmw_batch], chunksize=4
+        )
+      else:
+        results = (preprocess_zmw(z, options) for z in zmw_batch)
+      for zmw_input, (features, zmw_counter) in zip(zmw_batch, results):
         n_subreads += len(zmw_input[0]) - 1
-        features, zmw_counter = preprocess_zmw(zmw_input, options)
         counter.update(zmw_counter)
         all_windows.extend(features)
       t1 = time.time()
+      if options.end_after_stage == 'tf_examples':
+        timing_rows.append(
+            dict(stage='preprocess', runtime=t1 - t0,
+                 n_zmws=len(zmw_batch), n_examples=len(all_windows),
+                 n_subreads=n_subreads))
+        return
       to_model, to_skip = _triage_windows(all_windows, options, counter)
       predictions = [
           process_skipped_window(fd, options) for fd in to_skip
@@ -330,6 +353,12 @@ def run_inference(
           run_model_on_windows(to_model, runner, params, options)
       )
       t2 = time.time()
+      if options.end_after_stage == 'run_model':
+        timing_rows.append(
+            dict(stage='run_model', runtime=t2 - t1,
+                 n_zmws=len(zmw_batch), n_examples=len(all_windows),
+                 n_subreads=n_subreads))
+        return
       predictions.sort(key=lambda p: (p.molecule_name, p.window_pos))
       for name, group in itertools.groupby(
           predictions, key=lambda p: p.molecule_name
@@ -366,6 +395,9 @@ def run_inference(
     flush_zmw_batch(zmw_batch)
   finally:
     close_out()
+    if pool is not None:
+      pool.close()
+      pool.join()
 
   # Sidecar outputs (reference: quick_inference.py:777-791,961-962).
   with open(output + '.runtime.csv', 'w', newline='') as f:

@@ -133,6 +133,30 @@ def _set_base_fc_hparams(params):
   params.epsilon = 1e-6
 
 
+def _set_base_conv_hparams(params):
+  """Convolutional (ResNet-v2) model family."""
+  params.model_name = 'conv_net'
+  params.conv_model = 'resnet50'
+  params.num_channels = 1
+  params.per_base_hidden_size = 1
+  params.pw_hidden_size = 1
+  params.ip_hidden_size = 1
+  params.strand_hidden_size = 1
+  params.ccs_bq_hidden_size = 1
+  params.sn_hidden_size = 1
+  params.batch_size = 256
+  params.num_epochs = 9
+  params.num_epochs_for_decay = 9
+  params.buffer_size = 1_000_000
+  params.initial_learning_rate = 3.6246e-3
+  params.end_learning_rate = 2.86594e-5
+  params.warmup_steps = 35536
+  params.weight_decay_rate = 6.9868e-3
+  params.beta_1 = 0.9
+  params.beta_2 = 0.999
+  params.epsilon = 1e-6
+
+
 _TESTDATA = '/root/reference/deepconsensus/testdata'
 
 
@@ -237,6 +261,8 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.limit = -1
   if model_config_name == 'fc':
     _set_base_fc_hparams(params)
+  elif model_config_name == 'conv_net':
+    _set_base_conv_hparams(params)
   elif model_config_name == 'transformer':
     _set_base_transformer_hparams(params)
   elif model_config_name == 'transformer_learn_values':

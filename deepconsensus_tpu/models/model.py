@@ -338,4 +338,8 @@ def get_model(params: ml_collections.ConfigDict) -> nn.Module:
     return DeepConsensusModel(frozen)
   if params.model_name == 'fc':
     return FullyConnectedModel(frozen)
+  if params.model_name == 'conv_net':
+    from deepconsensus_tpu.models.convnet import ConvNetModel
+
+    return ConvNetModel(frozen)
   raise ValueError(f'Unknown model name: {params.model_name}')

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import time
 from typing import Any, Dict, Iterator, Optional, Tuple
@@ -236,6 +237,11 @@ class Trainer:
         path, {'params': jax.device_get(state.params), 'step': step},
         force=True,
     )
+    # Block until the async write finalizes so a crash right after this
+    # point never leaves a half-written latest checkpoint.
+    wait = getattr(self._checkpointer, 'wait_until_finished', None)
+    if wait is not None:
+      wait()
     header_needed = not os.path.exists(self._metrics_tsv)
     with open(self._metrics_tsv, 'a') as f:
       if header_needed:
@@ -346,23 +352,68 @@ def run_training(
         )
     return result
 
+  # Crash-resume: pick up from the newest checkpoint in out_dir
+  # (reference resumable training: model_utils.py:511-540).
   step = 0
+  latest = trainer.latest_checkpoint()
+  if latest and warm_start is None:
+    state = trainer.restore_checkpoint(state, latest)
+    step = int(latest.rsplit('-', 1)[1])
+    state = state.replace(step=jnp.asarray(step))
+
+  profile_dir = params.get('profile_dir', None)
+  if profile_dir:
+    jax.profiler.start_trace(profile_dir)
+
   final_metrics: Dict[str, float] = {}
-  for epoch in range(num_epochs):
-    for batch in train_ds.epoch():
-      state, m = train_step(state, batch)
-      step += 1
-      if step % params.get('log_every_n_steps', 100) == 0:
-        m_host = {k: float(v) for k, v in m.items()}
-        m_host['train/accuracy'] = m_host['accuracy_correct'] / max(
-            m_host['accuracy_total'], 1
-        )
-        trainer.log_metrics(step, 'train', m_host)
-      if step % eval_every == 0:
-        final_metrics = run_eval(state)
-        trainer.log_metrics(step, 'eval', final_metrics)
-        trainer.save_checkpoint(state, step, final_metrics)
-  final_metrics = run_eval(state)
-  trainer.log_metrics(step, 'eval', final_metrics)
-  trainer.save_checkpoint(state, step, final_metrics)
+  try:
+    steps_done_target = step
+    for epoch in range(num_epochs):
+      for batch in train_ds.epoch():
+        if steps_done_target > 0:
+          # Skip batches already covered by the restored checkpoint.
+          steps_done_target -= 1
+          continue
+        with jax.profiler.StepTraceAnnotation('train', step_num=step):
+          state, m = train_step(state, batch)
+        step += 1
+        if step % params.get('log_every_n_steps', 100) == 0:
+          m_host = {k: float(v) for k, v in m.items()}
+          m_host['train/accuracy'] = m_host['accuracy_correct'] / max(
+              m_host['accuracy_total'], 1
+          )
+          trainer.log_metrics(step, 'train', m_host)
+        if step % eval_every == 0:
+          final_metrics = run_eval(state)
+          trainer.log_metrics(step, 'eval', final_metrics)
+          trainer.save_checkpoint(state, step, final_metrics)
+    final_metrics = run_eval(state)
+    trainer.log_metrics(step, 'eval', final_metrics)
+    trainer.save_checkpoint(state, step, final_metrics)
+  finally:
+    if profile_dir:
+      jax.profiler.stop_trace()
   return final_metrics
+
+
+def run_training_with_retry(*args, max_retries: int = 1_000_000, **kwargs):
+  """Retries training on device-unavailable errors (TPU preemption),
+  resuming from the latest checkpoint (reference retry-forever loop:
+  model_train_custom_loop.py:333-347)."""
+  attempts = 0
+  while True:
+    try:
+      return run_training(*args, **kwargs)
+    except Exception as e:  # pylint: disable=broad-except
+      message = str(e)
+      transient = any(
+          key in message.upper()
+          for key in ('UNAVAILABLE', 'DEADLINE_EXCEEDED', 'PREEMPT')
+      )
+      attempts += 1
+      if not transient or attempts > max_retries:
+        raise
+      logging.getLogger(__name__).warning(
+          'transient device failure (%s); restarting from latest '
+          'checkpoint (attempt %d)', message.splitlines()[0], attempts,
+      )

@@ -1,0 +1,56 @@
+"""Multi-host initialization and per-host input sharding helpers.
+
+The reference reaches multi-host scale through TPUStrategy's cluster
+resolver (reference: models/model_train_custom_loop.py:333-343). The
+JAX equivalent is jax.distributed plus global device meshes; each host
+feeds its local shard of the global batch.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+  """Initializes jax.distributed (no-op when single-process).
+
+  On Cloud TPU pods the arguments auto-detect from the environment.
+  """
+  if num_processes in (None, 1) and coordinator_address is None:
+    if jax.process_count() == 1:
+      log.info('single-process run; skipping jax.distributed')
+      return
+  jax.distributed.initialize(
+      coordinator_address=coordinator_address,
+      num_processes=num_processes,
+      process_id=process_id,
+  )
+  log.info(
+      'distributed initialized: process %d/%d, %d local / %d global devices',
+      jax.process_index(), jax.process_count(),
+      jax.local_device_count(), jax.device_count(),
+  )
+
+
+def local_batch_slice(global_batch_size: int) -> slice:
+  """The slice of the global batch this host should feed."""
+  per_host = global_batch_size // jax.process_count()
+  start = jax.process_index() * per_host
+  return slice(start, start + per_host)
+
+
+def host_local_to_global(mesh, pspec, local_array):
+  """Assembles a globally-sharded array from per-host local shards."""
+  from jax.experimental import multihost_utils
+
+  return multihost_utils.host_local_array_to_global_array(
+      local_array, mesh, pspec
+  )

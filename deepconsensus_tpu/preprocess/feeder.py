@@ -89,21 +89,49 @@ ZmwInput = Tuple[List[AlignedRead], str, FeatureLayout, str,
                  Optional[np.ndarray]]
 
 
+def _fasta_ccs_iter(path: str):
+  """Yields pseudo CCS records from a FASTA (no quals/tags), supporting
+  the reference's --ccs_fasta input mode."""
+  import numpy as np
+
+  from deepconsensus_tpu.io import fastx
+
+  for name, seq in fastx.read_fasta(path).items():
+    yield bam.BamRecord(
+        qname=name,
+        flag=4,
+        ref_id=-1,
+        pos=0,
+        mapq=255,
+        cigar_ops=np.empty(0, dtype=np.uint8),
+        cigar_lens=np.empty(0, dtype=np.int32),
+        seq=seq,
+        quals=None,
+        tags={},
+    )
+
+
 def create_proc_feeder(
     subreads_to_ccs: str,
-    ccs_bam: str,
-    layout: FeatureLayout,
+    ccs_bam: Optional[str] = None,
+    layout: FeatureLayout = None,
     ins_trim: int = 0,
     use_ccs_smart_windows: bool = False,
     truth_bed: Optional[str] = None,
     truth_to_ccs: Optional[str] = None,
     truth_split: Optional[str] = None,
     limit: int = 0,
+    ccs_fasta: Optional[str] = None,
 ):
   """Returns (generator_fn, counter) yielding per-ZMW work items."""
   main_counter: Counter = Counter()
   grouper = bam.SubreadGrouper(subreads_to_ccs)
-  ccs_iter = iter(bam.BamReader(ccs_bam))
+  if ccs_bam:
+    ccs_iter = iter(bam.BamReader(ccs_bam))
+  elif ccs_fasta:
+    ccs_iter = _fasta_ccs_iter(ccs_fasta)
+  else:
+    raise ValueError('need ccs_bam or ccs_fasta')
 
   is_training = bool(truth_bed and truth_to_ccs and truth_split)
   if is_training:

@@ -1,0 +1,77 @@
+"""Error-analysis helpers (notebook/colab-style utilities).
+
+Counterpart of the reference's colab utilities (reference:
+deepconsensus/utils/colab_utils.py:28-159): run a model over example
+dicts, pretty-print base-level diffs, and summarize error k-mers.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.utils import phred
+
+
+def get_prediction(model_apply, variables, rows: np.ndarray) -> Dict:
+  """Runs the model on one example's rows; returns bases + qualities."""
+  import jax.numpy as jnp
+
+  preds = np.asarray(model_apply(variables, jnp.asarray(rows[None])))[0]
+  pred_ids = preds.argmax(-1)
+  error_prob = np.maximum(1 - preds.max(-1), 1e-12)
+  quals = np.minimum(-10 * np.log10(error_prob), 93).round().astype(int)
+  return {
+      'probabilities': preds,
+      'sequence': phred.encoded_sequence_to_string(pred_ids),
+      'quality_scores': quals,
+  }
+
+
+def diff_strings(truth: str, pred: str) -> List[Tuple[int, str, str]]:
+  """Positions where truth and prediction disagree."""
+  out = []
+  for i, (t, p) in enumerate(zip(truth, pred)):
+    if t != p:
+      out.append((i, t, p))
+  return out
+
+
+def format_diff(truth: str, pred: str, width: int = 80) -> str:
+  """Three-line alignment view with carets at mismatches."""
+  lines = []
+  for start in range(0, max(len(truth), len(pred)), width):
+    t = truth[start : start + width]
+    p = pred[start : start + width]
+    marks = ''.join(
+        '^' if i < len(t) and i < len(p) and t[i] != p[i] else ' '
+        for i in range(max(len(t), len(p)))
+    )
+    lines.extend([f'truth {t}', f'pred  {p}', f'      {marks}'])
+  return '\n'.join(lines)
+
+
+def error_kmers(
+    truth: str, pred: str, k: int = 5
+) -> collections.Counter:
+  """Counts truth-context k-mers centered on mismatch positions."""
+  counter: collections.Counter = collections.Counter()
+  half = k // 2
+  for pos, _, _ in diff_strings(truth, pred):
+    lo = max(pos - half, 0)
+    kmer = truth[lo : lo + k]
+    if len(kmer) == k:
+      counter[kmer] += 1
+  return counter
+
+
+def summarize_errors(
+    pairs: Iterable[Tuple[str, str]], k: int = 5, top: int = 20
+) -> List[Tuple[str, int]]:
+  """Aggregates the most error-prone k-mer contexts across reads."""
+  total: collections.Counter = collections.Counter()
+  for truth, pred in pairs:
+    total.update(error_kmers(truth, pred, k))
+  return total.most_common(top)

@@ -1,0 +1,33 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+
+
+def test_convnet_forward():
+  params = config_lib.get_config('conv_net+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.conv_model = 'resnet50'
+  model = model_lib.get_model(params)
+  rows = jnp.asarray(
+      np.random.default_rng(0)
+      .integers(0, 5, size=(2, params.total_rows, 100, 1))
+      .astype(np.float32)
+  )
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  assert 'batch_stats' in variables
+  preds = model.apply(variables, rows)
+  assert preds.shape == (2, 100, 5)
+  np.testing.assert_allclose(
+      np.asarray(preds.sum(-1)), np.ones((2, 100)), atol=1e-5
+  )
+
+
+def test_resnet_depths_registered():
+  from deepconsensus_tpu.models.convnet import RESNET_DEPTHS
+
+  assert set(RESNET_DEPTHS) == {'resnet50', 'resnet101', 'resnet152'}

@@ -1,0 +1,133 @@
+"""Resume-from-checkpoint, retry-on-preemption, ccs_fasta input, and
+the inference worker pool."""
+import os
+
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import train as train_lib
+
+
+def tiny_params():
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.batch_size = 8
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+    params.warmup_steps = 2
+  return params
+
+
+def test_retry_wrapper_retries_transient(monkeypatch, tmp_path):
+  calls = []
+
+  def fake_run_training(*args, **kwargs):
+    calls.append(1)
+    if len(calls) < 3:
+      raise RuntimeError('UNAVAILABLE: TPU preempted')
+    return {'eval/loss': 1.0}
+
+  monkeypatch.setattr(train_lib, 'run_training', fake_run_training)
+  out = train_lib.run_training_with_retry()
+  assert out == {'eval/loss': 1.0}
+  assert len(calls) == 3
+
+
+def test_retry_wrapper_raises_permanent(monkeypatch):
+  def fake_run_training(*args, **kwargs):
+    raise RuntimeError('INVALID_ARGUMENT: bad shape')
+
+  monkeypatch.setattr(train_lib, 'run_training', fake_run_training)
+  with pytest.raises(RuntimeError, match='INVALID_ARGUMENT'):
+    train_lib.run_training_with_retry()
+
+
+def test_training_resumes_from_checkpoint(tmp_path, testdata_dir):
+  params = tiny_params()
+  out_dir = str(tmp_path / 'resume')
+  patterns = [str(testdata_dir / 'human_1m/tf_examples/eval/*')]  # 65 ex
+  m1 = train_lib.run_training(
+      params=params, out_dir=out_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=1, eval_every=10**9,
+  )
+  def list_ckpts(d):
+    return {
+        name for name in os.listdir(os.path.join(d, 'checkpoints'))
+        if not name.endswith('-tmp')
+    }
+
+  ckpts = list_ckpts(out_dir)
+  # Second invocation with a larger epoch budget restores the latest
+  # checkpoint, skips the completed steps, and trains the remainder.
+  m2 = train_lib.run_training(
+      params=params, out_dir=out_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=2, eval_every=10**9,
+  )
+  ckpts2 = list_ckpts(out_dir)
+  assert ckpts2 > ckpts  # a later-step checkpoint was added
+  assert np.isfinite(m2['eval/loss'])
+
+
+def test_ccs_fasta_feeder(tmp_path, testdata_dir):
+  """Feeding CCS drafts from FASTA instead of BAM."""
+  from deepconsensus_tpu.io import bam as bam_lib
+  from deepconsensus_tpu.preprocess import FeatureLayout, create_proc_feeder
+
+  td = str(testdata_dir / 'human_1m')
+  # Build a FASTA of the ccs drafts.
+  fasta = tmp_path / 'ccs.fasta'
+  with open(fasta, 'w') as f:
+    for rec in bam_lib.BamReader(f'{td}/ccs.bam'):
+      f.write(f'>{rec.qname}\n{rec.seq}\n')
+  layout = FeatureLayout(20, 100)
+  feeder, counter = create_proc_feeder(
+      subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+      ccs_fasta=str(fasta),
+      layout=layout,
+      ins_trim=5,
+      limit=2,
+  )
+  items = list(feeder())
+  assert len(items) == 2
+  subreads, name, *_ = items[0]
+  ccs_read = subreads[-1]
+  assert ccs_read.name == name
+  # FASTA mode has no quality scores -> zeros.
+  assert (ccs_read.base_quality_scores == 0).all()
+
+
+def test_inference_with_worker_pool(tmp_path, testdata_dir):
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.inference import runner as runner_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+  options = runner_lib.InferenceOptions(
+      batch_size=32, batch_zmws=4, limit=2, cpus=2
+  )
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  runner = runner_lib.ModelRunner(params, variables, options)
+  out = str(tmp_path / 'pooled.fastq')
+  counters = runner_lib.run_inference(
+      subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+      ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+      checkpoint=None,
+      output=out,
+      options=options,
+      runner=runner,
+  )
+  assert counters['n_zmw_pass'] == 2

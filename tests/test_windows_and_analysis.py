@@ -1,0 +1,98 @@
+"""Smart-window width translation and error-analysis utilities."""
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.preprocess.alignment import AlignedRead
+from deepconsensus_tpu.preprocess.pileup import FeatureLayout, Pileup
+from deepconsensus_tpu.utils import analysis
+
+C = constants.Cigar
+M, I = int(C.MATCH), int(C.INS)
+
+
+def make_pileup(sub_seq, sub_cigar, ccs_seq, window_widths=None):
+  from deepconsensus_tpu.preprocess.spacing import space_out_reads
+
+  def read(seq, cig, name):
+    bases = np.array([constants.SEQ_VOCAB.index(c) for c in seq], np.uint8)
+    cigar = np.array(cig, np.uint8)
+    is_ref = np.array([op != I for op in cig])
+    ccs_idx = np.where(is_ref, np.cumsum(is_ref) - 1, -1).astype(np.int64)
+    return AlignedRead(
+        name=name, bases=bases, cigar=cigar,
+        pw=np.ones(len(seq), np.int32), ip=np.ones(len(seq), np.int32),
+        sn=np.ones(4, np.float32), strand=constants.Strand.FORWARD,
+        ccs_idx=ccs_idx,
+        base_quality_scores=np.full(len(seq), 30, np.int64)
+        if name.endswith('ccs') else np.empty(0, np.int64),
+    )
+
+  reads = [
+      read(sub_seq, sub_cigar, 'm/1/0_10'),
+      read(ccs_seq, [M] * len(ccs_seq), 'm/1/ccs'),
+  ]
+  spaced = space_out_reads(reads)
+  return Pileup(
+      name='m/1/ccs', reads=spaced, layout=FeatureLayout(2, 4),
+      window_widths=window_widths,
+  )
+
+
+def test_standard_windows():
+  p = make_pileup('ACGTACGT', [M] * 8, 'ACGTACGT')
+  assert p.calculate_windows(4) == [4, 4]
+  p2 = make_pileup('ACGTAC', [M] * 6, 'ACGTAC')
+  assert p2.calculate_windows(4) == [4, 4]
+
+
+def test_smart_windows_translate_spacing():
+  # Subread insertion after base 1 creates a gap column in the CCS, so
+  # a 2-base smart window spans 3 columns.
+  p = make_pileup(
+      'ATCGT', [M, I, M, M, M], 'ACGT',
+      window_widths=np.array([2, 2]),
+  )
+  assert str(p.ccs) == 'A CGT'
+  assert p.calculate_windows(100) == [3, 2]
+
+
+def test_smart_windows_width_mismatch_raises():
+  p = make_pileup('ACGT', [M] * 4, 'ACGT', window_widths=np.array([2, 1]))
+  with pytest.raises(ValueError):
+    p.calculate_windows(100)
+
+
+def test_diff_and_kmers():
+  truth = 'ACGTACGT'
+  pred = 'ACCTACGA'
+  diffs = analysis.diff_strings(truth, pred)
+  assert diffs == [(2, 'G', 'C'), (7, 'T', 'A')]
+  view = analysis.format_diff(truth, pred)
+  assert '^' in view and 'truth' in view
+  kmers = analysis.error_kmers(truth, pred, k=3)
+  assert kmers['CGT'] == 1  # context around position 2
+  top = analysis.summarize_errors([(truth, pred)], k=3, top=5)
+  assert len(top) >= 1
+
+
+def test_get_prediction_shapes():
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+  model = model_lib.get_model(params)
+  rows = np.zeros((params.total_rows, 100, 1), np.float32)
+  variables = model.init(jax.random.PRNGKey(0), jnp.asarray(rows[None]))
+  out = analysis.get_prediction(model.apply, variables, rows)
+  assert len(out['sequence']) == 100
+  assert out['quality_scores'].shape == (100,)
+  assert out['probabilities'].shape == (100, 5)
