@@ -166,3 +166,80 @@ class DatasetIterator:
   def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
     while True:
       yield from self.epoch()
+
+
+@dataclasses.dataclass
+class StreamingDataset:
+  """Shard-interleaved streaming loader with a shuffle buffer.
+
+  For corpora too large for memory (the reference trains on ~100M
+  examples): shards are read round-robin on a background thread, parsed
+  examples fill a reservoir shuffle buffer, and fixed-size batches are
+  drawn indefinitely (reference semantics: data_providers.py:395-425).
+  """
+
+  patterns: Union[str, Sequence[str]]
+  params: ml_collections.ConfigDict
+  batch_size: int
+  buffer_size: int = 100_000
+  seed: int = 1
+  inference: bool = False
+
+  def __post_init__(self):
+    from deepconsensus_tpu.io.tfrecord import glob_paths
+
+    self._paths = glob_paths(self.patterns)
+    if not self._paths:
+      raise ValueError(f'no shards matched {self.patterns!r}')
+    self._rng = np.random.default_rng(self.seed)
+
+  def _raw_stream(self) -> Iterator[bytes]:
+    """Round-robin interleave across shards, repeating forever."""
+    from deepconsensus_tpu.io.tfrecord import TFRecordReader
+
+    epoch = 0
+    while True:
+      order = self._rng.permutation(len(self._paths))
+      readers = [
+          iter(TFRecordReader(self._paths[i])) for i in order
+      ]
+      while readers:
+        alive = []
+        for reader in readers:
+          try:
+            yield next(reader)
+            alive.append(reader)
+          except StopIteration:
+            pass
+        readers = alive
+      epoch += 1
+
+  def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+    import queue as queue_lib
+    import threading
+
+    raw_queue: 'queue_lib.Queue' = queue_lib.Queue(maxsize=4096)
+
+    def producer():
+      for raw in self._raw_stream():
+        raw_queue.put(raw)
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+
+    buffer: List[Dict[str, np.ndarray]] = []
+    fill_target = max(self.buffer_size, self.batch_size * 2)
+    while True:
+      while len(buffer) < fill_target:
+        parsed = parse_example(
+            raw_queue.get(), self.params, self.inference
+        )
+        buffer.append(parsed)
+      idx = self._rng.choice(len(buffer), self.batch_size, replace=False)
+      idx_set = set(idx.tolist())
+      chosen = [buffer[i] for i in idx]
+      buffer = [b for i, b in enumerate(buffer) if i not in idx_set]
+      batch = {'rows': np.stack([c['rows'] for c in chosen])}
+      if not self.inference:
+        batch['label'] = np.stack([c['label'] for c in chosen])
+      yield batch

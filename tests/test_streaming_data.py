@@ -1,0 +1,25 @@
+import itertools
+
+import numpy as np
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import data as data_lib
+
+
+def test_streaming_dataset(testdata_dir):
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  ds = data_lib.StreamingDataset(
+      patterns=str(testdata_dir / 'human_1m/tf_examples/train/*'),
+      params=params,
+      batch_size=16,
+      buffer_size=64,
+  )
+  batches = list(itertools.islice(iter(ds), 5))
+  assert len(batches) == 5
+  for batch in batches:
+    assert batch['rows'].shape == (16, 85, 100, 1)
+    assert batch['label'].shape == (16, 100)
+  # Stream repeats past one epoch without exhausting (1239 examples).
+  more = list(itertools.islice(iter(ds), 100))
+  assert len(more) == 100
