@@ -119,14 +119,23 @@ class ModelRunner:
     )
     return cls(params, {'params': restored['params']}, options)
 
-  def predict(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """rows [B, R, L, 1] -> (base ids [B, L], quality scores [B, L])."""
+  def dispatch(self, rows: np.ndarray):
+    """Async device dispatch: rows [B, R, L, 1] -> (dev_ids, dev_prob, n).
+
+    Pads to the fixed compiled batch shape and returns device arrays
+    immediately so the next batch's host work overlaps device compute.
+    """
     n = rows.shape[0]
     batch = self.options.batch_size
-    if n < batch:  # pad to the fixed compiled shape
+    if n < batch:
       pad = np.zeros((batch - n,) + rows.shape[1:], rows.dtype)
       rows = np.concatenate([rows, pad])
     pred_ids, max_prob = self._forward(self.variables, jnp.asarray(rows))
+    return pred_ids, max_prob, n
+
+  def finalize(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
+    """Resolves a dispatch into (base ids [n, L], quality [n, L])."""
+    pred_ids, max_prob, n = dispatched
     pred_ids = np.asarray(pred_ids[:n])
     max_prob = np.asarray(max_prob[:n])
     error_prob = np.maximum(1.0 - max_prob, 1e-12)
@@ -140,6 +149,10 @@ class ModelRunner:
     quality = np.round(quality, decimals=0).astype(np.int32)
     quality = np.maximum(quality, 0)
     return pred_ids, quality
+
+  def predict(self, rows: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Synchronous convenience wrapper."""
+    return self.finalize(self.dispatch(rows))
 
 
 def preprocess_zmw(
@@ -217,10 +230,14 @@ def run_model_on_windows(
   processed = [
       data_lib.process_feature_dict(fd, params) for fd in feature_dicts
   ]
-  for start in range(0, len(processed), options.batch_size):
-    chunk = processed[start : start + options.batch_size]
-    rows = np.stack([c['rows'] for c in chunk])
-    pred_ids, quality = runner.predict(rows)
+
+  # Double-buffered: dispatch batch i+1 before finalizing batch i so
+  # host-side stacking/quality math overlaps device compute.
+  pending: List[Tuple[List, Any]] = []
+
+  def drain(entry):
+    chunk, dispatched = entry
+    pred_ids, quality = runner.finalize(dispatched)
     for c, ids, quals in zip(chunk, pred_ids, quality):
       outputs.append(
           stitch.DCModelOutput(
@@ -235,6 +252,15 @@ def run_model_on_windows(
               rg=c['rg'],
           )
       )
+
+  for start in range(0, len(processed), options.batch_size):
+    chunk = processed[start : start + options.batch_size]
+    rows = np.stack([c['rows'] for c in chunk])
+    pending.append((chunk, runner.dispatch(rows)))
+    if len(pending) > 1:
+      drain(pending.pop(0))
+  while pending:
+    drain(pending.pop(0))
   return outputs
 
 

@@ -1,0 +1,47 @@
+"""Shared test fixtures/builders (counterpart of the reference's
+utils/test_utils.py:49-161)."""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+
+
+def seq_to_array(seq: str) -> np.ndarray:
+  """ASCII sequence -> float vocab ids ('A T' -> [1, 0, 2])."""
+  return np.array(
+      [constants.SEQ_VOCAB.index(c) for c in seq], dtype=np.float32
+  )
+
+
+def seq_to_one_hot(seq: str) -> np.ndarray:
+  """ASCII sequence -> one-hot [len, vocab] distribution."""
+  eye = np.eye(constants.SEQ_VOCAB_SIZE, dtype=np.float32)
+  return np.stack([eye[constants.SEQ_VOCAB.index(c)] for c in seq])
+
+
+def get_one_hot(index: int) -> np.ndarray:
+  return np.eye(constants.SEQ_VOCAB_SIZE, dtype=np.float32)[index]
+
+
+def multiseq_to_array(seqs: Sequence[str]) -> np.ndarray:
+  """List of equal-length sequences -> [n, len] vocab-id matrix."""
+  return np.stack([seq_to_array(s) for s in seqs])
+
+
+def convert_seqs(
+    sequences: Tuple[Sequence[str], Sequence[str]]
+) -> Tuple[np.ndarray, np.ndarray]:
+  """(labels, predictions) string lists -> (y_true ids, y_pred one-hot)."""
+  y_true = multiseq_to_array(sequences[0])
+  y_pred = np.stack([seq_to_one_hot(s) for s in sequences[1]])
+  return y_true, y_pred
+
+
+def load_dataset_examples(pattern: str) -> List[bytes]:
+  """All serialized examples matching a TFRecord glob."""
+  from deepconsensus_tpu.io.tfrecord import read_tfrecords
+
+  return list(read_tfrecords(pattern))
