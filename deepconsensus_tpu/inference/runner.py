@@ -102,8 +102,17 @@ class ModelRunner:
   @classmethod
   def from_checkpoint(cls, checkpoint_path: str,
                       options: InferenceOptions) -> 'ModelRunner':
-    import orbax.checkpoint as ocp
+    """Loads either an orbax checkpoint or an exported StableHLO
+    artifact directory (the reference's SavedModel-vs-checkpoint
+    detection: quick_inference.py:797-800,512-529)."""
     import os
+
+    if os.path.isdir(checkpoint_path) and os.path.exists(
+        os.path.join(checkpoint_path, 'serving.stablehlo')
+    ):
+      return cls.from_exported(checkpoint_path, options)
+
+    import orbax.checkpoint as ocp
 
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
@@ -118,6 +127,31 @@ class ModelRunner:
         target={'params': jax.device_get(variables['params']), 'step': 0},
     )
     return cls(params, {'params': restored['params']}, options)
+
+  @classmethod
+  def from_exported(cls, export_dir: str,
+                    options: InferenceOptions) -> 'ModelRunner':
+    """Serves an exported StableHLO artifact (params baked in)."""
+    from deepconsensus_tpu.models import export as export_lib
+
+    serving, meta = export_lib.load_exported(export_dir)
+    params = config_lib.read_params_from_json(export_dir)
+    config_lib.finalize_params(params, is_training=False)
+    runner = cls.__new__(cls)
+    runner.params = params
+    runner.variables = None
+    options.batch_size = int(meta['batch_size'])
+    runner.options = options
+
+    def forward(_variables, rows):
+      preds = serving(rows)
+      return (
+          jnp.argmax(preds, axis=-1).astype(jnp.int32),
+          jnp.max(preds, axis=-1),
+      )
+
+    runner._forward = forward
+    return runner
 
   def dispatch(self, rows: np.ndarray):
     """Async device dispatch: rows [B, R, L, 1] -> (dev_ids, dev_prob, n).

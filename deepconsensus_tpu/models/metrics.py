@@ -142,7 +142,7 @@ class AlignmentMetric:
 
     (_, _, v_opt, m_opt), dir_rows = jax.lax.scan(
         fwd_step, (v_all_p2, v_all_p1, v_opt, m_opt), (ks, subs_w),
-        unroll=4,
+        unroll=wavefront.SCAN_UNROLL,
     )
     # dir_all[k] for k = 0..m+n.
     dir_all = jnp.concatenate([dir0[None], dir1[None], dir_rows], axis=0)
@@ -179,7 +179,7 @@ class AlignmentMetric:
     ks_rev = jnp.arange(m + n, -1, -1)
     (_, _, _), path_rows = jax.lax.scan(
         bwd_step, (k_end, y_true_lens, m_opt), (ks_rev, dir_all[ks_rev]),
-        unroll=4,
+        unroll=wavefront.SCAN_UNROLL,
     )
     paths_sp = path_rows.reshape(-1, 4)
     paths = jnp.zeros((b, m + 1, n + 1), jnp.int32).at[

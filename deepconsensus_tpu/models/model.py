@@ -331,6 +331,21 @@ class FullyConnectedModel(nn.Module):
     return jax.nn.softmax(x, axis=-1)
 
 
+def summarize_params(variables) -> str:
+  """Human-readable parameter summary with per-module counts
+  (counterpart of reference print_model_summary: model_utils.py)."""
+  lines = []
+  total = 0
+  flat = jax.tree_util.tree_flatten_with_path(variables)[0]
+  for path, leaf in flat:
+    name = '/'.join(getattr(k, 'key', str(k)) for k in path)
+    count = int(np.prod(leaf.shape)) if leaf.shape else 1
+    total += count
+    lines.append(f'{name:70s} {str(leaf.shape):20s} {count:>12,}')
+  lines.append(f'{"TOTAL":70s} {"":20s} {total:>12,}')
+  return '\n'.join(lines)
+
+
 def get_model(params: ml_collections.ConfigDict) -> nn.Module:
   """Model factory (reference model_utils.py:142-152)."""
   frozen = ml_collections.FrozenConfigDict(params)

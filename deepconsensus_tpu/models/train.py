@@ -35,6 +35,18 @@ from deepconsensus_tpu.parallel import mesh as mesh_lib
 from deepconsensus_tpu.preprocess.pileup import row_indices
 
 
+def enable_compilation_cache(
+    cache_dir: str = '/tmp/dctpu_jax_cache',
+) -> None:
+  """Persistent XLA compilation cache: the differentiated wavefront
+  scans compile slowly on TPU, so amortize across processes."""
+  try:
+    jax.config.update('jax_compilation_cache_dir', cache_dir)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 10)
+  except Exception:  # pragma: no cover - older jax
+    pass
+
+
 class TrainState(ts_lib.TrainState):
   dropout_rng: jax.Array = struct.field(pytree_node=True, default=None)
 
@@ -118,6 +130,7 @@ class Trainer:
 
   def __post_init__(self):
     os.makedirs(self.out_dir, exist_ok=True)
+    enable_compilation_cache()
     self.model = model_lib.get_model(self.params)
     self.loss_fn = make_loss(self.params)
     self.alignment_metric = metrics_lib.AlignmentMetric()

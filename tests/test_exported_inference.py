@@ -1,0 +1,43 @@
+"""Inference from an exported StableHLO artifact (SavedModel-path
+equivalent)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.models import (
+    config as config_lib,
+    export as export_lib,
+    model as model_lib,
+)
+
+
+def test_run_inference_from_export(tmp_path, testdata_dir):
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  export_dir = str(tmp_path / 'export')
+  export_lib.export_model(
+      checkpoint_path=export_dir,
+      out_dir=export_dir,
+      batch_size=32,
+      variables=variables,
+      params=params,
+  )
+  options = runner_lib.InferenceOptions(batch_zmws=4, limit=2)
+  out = str(tmp_path / 'from_export.fastq')
+  counters = runner_lib.run_inference(
+      subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+      ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+      checkpoint=export_dir,
+      output=out,
+      options=options,
+  )
+  assert counters['n_zmw_pass'] == 2
+  assert options.batch_size == 32  # adopted from export meta
