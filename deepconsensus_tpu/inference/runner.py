@@ -107,8 +107,10 @@ class ModelRunner:
     detection: quick_inference.py:797-800,512-529)."""
     import os
 
+    from deepconsensus_tpu.models import export as export_lib
+
     if os.path.isdir(checkpoint_path) and os.path.exists(
-        os.path.join(checkpoint_path, 'serving.stablehlo')
+        os.path.join(checkpoint_path, export_lib.ARTIFACT_NAME)
     ):
       return cls.from_exported(checkpoint_path, options)
 
@@ -143,6 +145,7 @@ class ModelRunner:
     options.batch_size = int(meta['batch_size'])
     runner.options = options
 
+    @jax.jit
     def forward(_variables, rows):
       preds = serving(rows)
       return (

@@ -361,10 +361,23 @@ def read_params_from_json(
 ) -> ml_collections.ConfigDict:
   """Loads params.json from a checkpoint directory or file prefix
   (model_utils.py:434-465). Unknown keys are kept (forward compat)."""
-  if os.path.isdir(checkpoint_path):
-    json_path = os.path.join(checkpoint_path, 'params.json')
+  # Orbax checkpoints are directories under <out_dir>/checkpoints/, so
+  # walk up from the given path until params.json is found.
+  candidates = []
+  base = checkpoint_path if os.path.isdir(checkpoint_path) else (
+      os.path.dirname(checkpoint_path)
+  )
+  for _ in range(3):
+    candidates.append(os.path.join(base, 'params.json'))
+    base = os.path.dirname(base)
+  for json_path in candidates:
+    if os.path.exists(json_path):
+      break
   else:
-    json_path = os.path.join(os.path.dirname(checkpoint_path), 'params.json')
+    raise FileNotFoundError(
+        f'params.json not found near {checkpoint_path!r}; looked in '
+        f'{candidates}'
+    )
   with open(json_path) as f:
     loaded = json.load(f)
   params = get_config()

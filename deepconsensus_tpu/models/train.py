@@ -35,15 +35,24 @@ from deepconsensus_tpu.parallel import mesh as mesh_lib
 from deepconsensus_tpu.preprocess.pileup import row_indices
 
 
-def enable_compilation_cache(
-    cache_dir: str = '/tmp/dctpu_jax_cache',
-) -> None:
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
   """Persistent XLA compilation cache: the differentiated wavefront
-  scans compile slowly on TPU, so amortize across processes."""
+  scans compile slowly on TPU, so amortize across processes.
+
+  Directory resolution: explicit arg > DC_TPU_COMPILE_CACHE env var >
+  per-user default. Set DC_TPU_COMPILE_CACHE=off to disable.
+  """
+  cache_dir = cache_dir or os.environ.get('DC_TPU_COMPILE_CACHE')
+  if cache_dir == 'off':
+    return
+  if cache_dir is None:
+    cache_dir = os.path.join(
+        os.path.expanduser('~'), '.cache', 'dctpu_jax_cache'
+    )
   try:
     jax.config.update('jax_compilation_cache_dir', cache_dir)
     jax.config.update('jax_persistent_cache_min_compile_time_secs', 10)
-  except Exception:  # pragma: no cover - older jax
+  except AttributeError:  # pragma: no cover - older jax
     pass
 
 
@@ -159,6 +168,8 @@ class Trainer:
         tx=tx,
         dropout_rng=jax.random.fold_in(rng, 1),
     )
+    with open(os.path.join(self.out_dir, 'model_summary.txt'), 'w') as f:
+      f.write(model_lib.summarize_params(variables['params']))
     # Place parameters according to the mesh sharding rules; optimizer
     # state follows the parameter shardings on first update.
     shardings = mesh_lib.param_shardings(self.mesh, state.params)

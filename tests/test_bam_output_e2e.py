@@ -1,0 +1,75 @@
+"""BAM-output inference mode and end_after_stage truncation."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+
+
+@pytest.fixture(scope='module')
+def runner_and_options():
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+  options = runner_lib.InferenceOptions(
+      batch_size=32, batch_zmws=4, limit=2, skip_windows_above=1,
+      min_quality=0,
+  )
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  return runner_lib.ModelRunner(params, variables, options), options
+
+
+def test_bam_output_mode(tmp_path, testdata_dir, runner_and_options):
+  runner, options = runner_and_options
+  out = str(tmp_path / 'polished.bam')
+  counters = runner_lib.run_inference(
+      subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+      ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+      checkpoint=None,
+      output=out,
+      options=options,
+      runner=runner,
+  )
+  records = list(bam_lib.BamReader(out))
+  assert len(records) == counters['success'] > 0
+  for rec in records:
+    assert rec.is_unmapped
+    assert rec.qname.endswith('/ccs')
+    assert rec.get_tag('zm') == int(rec.qname.split('/')[1])
+    # Aux tags propagate when present on the draft CCS record.
+    assert rec.has_tag('rq') and rec.has_tag('np')
+    assert rec.quals is not None and len(rec.quals) == len(rec.seq)
+
+
+@pytest.mark.parametrize('stage,expect_output', [
+    ('dc_input', False),
+    ('tf_examples', False),
+    ('run_model', False),
+    ('full', True),
+])
+def test_end_after_stage(tmp_path, testdata_dir, runner_and_options, stage,
+                         expect_output):
+  runner, base = runner_and_options
+  options = runner_lib.InferenceOptions(
+      batch_size=32, batch_zmws=4, limit=2, skip_windows_above=1,
+      min_quality=0, end_after_stage=stage,
+  )
+  out = str(tmp_path / f'{stage}.fastq')
+  counters = runner_lib.run_inference(
+      subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+      ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+      checkpoint=None,
+      output=out,
+      options=options,
+      runner=runner,
+  )
+  assert (counters.get('success', 0) > 0) == expect_output
