@@ -99,6 +99,54 @@ def stats_for_read(
       ref_pos += length
 
 
+def _process_contig(args) -> List[Dict[str, int]]:
+  """Worker: accumulates counts for one contig's records."""
+  bam, ref, contig, regions, min_mapq, dc_calibration = args
+  counts = [{'M': 0, 'X': 0} for _ in range(MAX_BASEQ)]
+  ref_seqs = fastx.read_fasta(ref)
+  cal = calibration_lib.parse_calibration_string(dc_calibration)
+  for record in bam_lib.BamReader(bam):
+    if record.reference_name != contig:
+      continue
+    _accumulate_record(record, ref_seqs, {contig: regions}, cal, min_mapq,
+                       counts)
+  return counts
+
+
+def _accumulate_record(record, ref_seqs, region_by_contig, cal, min_mapq,
+                       counts) -> None:
+  if (
+      record.is_unmapped
+      or record.is_secondary
+      or record.is_supplementary
+      or record.mapq < min_mapq
+      or record.quals is None
+      or record.reference_name not in ref_seqs
+  ):
+    return
+  quals = record.quals
+  if cal.enabled:
+    quals = np.round(
+        calibration_lib.calibrate_quality_scores(quals.astype(np.uint8), cal)
+    ).astype(np.int32)
+  ref_end = record.pos + int(
+      np.sum(
+          record.cigar_lens[
+              np.isin(record.cigar_ops,
+                      [Cigar.MATCH, Cigar.DEL, Cigar.REF_SKIP,
+                       Cigar.EQUAL, Cigar.DIFF])
+          ]
+      )
+  )
+  for interval in region_by_contig.get(record.reference_name, []):
+    if interval.stop < record.pos or interval.start >= ref_end:
+      continue
+    ref_slice = ref_seqs[record.reference_name][
+        interval.start : interval.stop + 1
+    ]
+    stats_for_read(record, ref_slice, interval, quals, counts)
+
+
 def calculate_quality_calibration(
     bam: str,
     ref: str,
@@ -108,7 +156,11 @@ def calculate_quality_calibration(
     cpus: int = 0,
     dc_calibration: str = 'skip',
 ) -> List[Tuple[int, int, int]]:
-  """Writes CSV rows (baseq, total_match, total_mismatch); returns them."""
+  """Writes CSV rows (baseq, total_match, total_mismatch); returns them.
+
+  With cpus>1, contigs fan out over a process pool (the reference pools
+  over interval round-robins: calculate_baseq_calibration.py:450-463).
+  """
   ref_seqs = fastx.read_fasta(ref)
   reader = bam_lib.BamReader(bam)
   contig_lengths = dict(
@@ -124,41 +176,28 @@ def calculate_quality_calibration(
   cal = calibration_lib.parse_calibration_string(dc_calibration)
   counts = [{'M': 0, 'X': 0} for _ in range(MAX_BASEQ)]
 
+  if cpus and cpus > 1 and len(region_by_contig) > 1:
+    import multiprocessing
+
+    work = [
+        (bam, ref, contig, contig_regions, min_mapq, dc_calibration)
+        for contig, contig_regions in region_by_contig.items()
+    ]
+    with multiprocessing.Pool(min(cpus, len(work))) as pool:
+      for partial in pool.imap_unordered(_process_contig, work):
+        for q in range(MAX_BASEQ):
+          counts[q]['M'] += partial[q]['M']
+          counts[q]['X'] += partial[q]['X']
+    rows = [(q, counts[q]['M'], counts[q]['X']) for q in range(MAX_BASEQ)]
+    with open(output, 'w', newline='') as f:
+      writer = csv.writer(f)
+      writer.writerow(['baseq', 'total_match', 'total_mismatch'])
+      writer.writerows(rows)
+    return rows
+
   for record in reader:
-    if (
-        record.is_unmapped
-        or record.is_secondary
-        or record.is_supplementary
-        or record.mapq < min_mapq
-        or record.quals is None
-        or record.reference_name not in ref_seqs
-    ):
-      continue
-    quals = record.quals
-    if cal.enabled:
-      quals = np.round(
-          calibration_lib.calibrate_quality_scores(
-              quals.astype(np.uint8), cal
-          )
-      ).astype(np.int32)
-    # Bin the read into every interval it overlaps, clipping counting
-    # to the interval bounds like the reference's fetch-per-interval.
-    ref_end = record.pos + int(
-        np.sum(
-            record.cigar_lens[
-                np.isin(record.cigar_ops,
-                        [Cigar.MATCH, Cigar.DEL, Cigar.REF_SKIP,
-                         Cigar.EQUAL, Cigar.DIFF])
-            ]
-        )
-    )
-    for interval in region_by_contig.get(record.reference_name, []):
-      if interval.stop < record.pos or interval.start >= ref_end:
-        continue
-      ref_slice = ref_seqs[record.reference_name][
-          interval.start : interval.stop + 1
-      ]
-      stats_for_read(record, ref_slice, interval, quals, counts)
+    _accumulate_record(record, ref_seqs, region_by_contig, cal, min_mapq,
+                       counts)
 
   rows = [
       (q, counts[q]['M'], counts[q]['X']) for q in range(MAX_BASEQ)

@@ -105,7 +105,20 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-  args = build_parser().parse_args(argv)
+  try:
+    return _dispatch(build_parser().parse_args(argv))
+  except FileNotFoundError as e:
+    print(f'dctpu: file not found: {e}', file=sys.stderr)
+    return 2
+  except ValueError as e:
+    print(f'dctpu: {e}', file=sys.stderr)
+    return 2
+  except KeyboardInterrupt:
+    print('dctpu: interrupted', file=sys.stderr)
+    return 130
+
+
+def _dispatch(args) -> int:
 
   if args.command == 'preprocess':
     from deepconsensus_tpu.preprocess.driver import run_preprocess

@@ -1,0 +1,50 @@
+"""Parallel calibrate equals serial; CLI error surfaces cleanly."""
+import csv
+
+
+def test_calibrate_parallel_equals_serial(testdata_dir, tmp_path):
+  from deepconsensus_tpu.calibration import measure
+
+  bam = str(
+      testdata_dir
+      / 'prediction_assessment/CHM13_chr20_0_200000_dc.to_truth.bam'
+  )
+  ref = str(testdata_dir / 'prediction_assessment/CHM13_chr20_0_200000.fa')
+  serial = measure.calculate_quality_calibration(
+      bam=bam, ref=ref, output=str(tmp_path / 's.csv'), min_mapq=0, cpus=0
+  )
+  parallel = measure.calculate_quality_calibration(
+      bam=bam, ref=ref, output=str(tmp_path / 'p.csv'), min_mapq=0, cpus=2
+  )
+  # Single contig in this testdata -> pool path may fall back; force a
+  # check on equality either way.
+  assert serial == parallel
+
+
+def test_cli_clean_errors(capsys):
+  from deepconsensus_tpu import cli
+
+  rc = cli.main([
+      'filter_reads', '--input', '/nope.fastq', '--output', '/tmp/x.fq',
+      '--quality', '10',
+  ])
+  assert rc == 2
+  err = capsys.readouterr().err
+  assert 'dctpu: file not found' in err
+
+
+def test_cli_clean_value_error(capsys, testdata_dir):
+  from deepconsensus_tpu import cli
+
+  td = str(testdata_dir / 'human_1m')
+  rc = cli.main([
+      'preprocess',
+      '--subreads_to_ccs', f'{td}/subreads_to_ccs.bam',
+      '--ccs_bam', f'{td}/ccs.bam',
+      '--truth_to_ccs', f'{td}/truth_to_ccs.bam',
+      '--truth_bed', f'{td}/truth.bed',
+      '--truth_split', f'{td}/truth_split.tsv',
+      '--output', '/tmp/no_split_here.tfrecord.gz',
+  ])
+  assert rc == 2
+  assert '@split' in capsys.readouterr().err
