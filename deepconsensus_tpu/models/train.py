@@ -58,6 +58,9 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
 
 class TrainState(ts_lib.TrainState):
   dropout_rng: jax.Array = struct.field(pytree_node=True, default=None)
+  # Non-trainable variable collections (e.g. BatchNorm batch_stats for
+  # the conv family); empty dict for purely-functional models.
+  model_state: Any = struct.field(pytree_node=True, default_factory=dict)
 
 
 def create_learning_rate_fn(
@@ -162,11 +165,13 @@ class Trainer:
     )
     variables = self.model.init(rng, rows)
     tx = create_optimizer(self.params, steps_total)
+    model_state = {k: v for k, v in variables.items() if k != 'params'}
     state = TrainState.create(
         apply_fn=self.model.apply,
         params=variables['params'],
         tx=tx,
         dropout_rng=jax.random.fold_in(rng, 1),
+        model_state=model_state,
     )
     with open(os.path.join(self.out_dir, 'model_summary.txt'), 'w') as f:
       f.write(model_lib.summarize_params(variables['params']))
@@ -182,17 +187,29 @@ class Trainer:
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
       rng = jax.random.fold_in(state.dropout_rng, state.step)
+      mutable = list(state.model_state.keys())
 
       def loss_of(p):
-        preds = state.apply_fn(
-            {'params': p}, batch['rows'], train=True, rngs={'dropout': rng}
-        )
-        return loss_obj(batch['label'], preds), preds
+        if mutable:
+          preds, new_model_state = state.apply_fn(
+              {'params': p, **state.model_state},
+              batch['rows'], train=True, rngs={'dropout': rng},
+              mutable=mutable,
+          )
+        else:
+          preds = state.apply_fn(
+              {'params': p}, batch['rows'], train=True,
+              rngs={'dropout': rng},
+          )
+          new_model_state = {}
+        return loss_obj(batch['label'], preds), (preds, new_model_state)
 
-      (loss, preds), grads = jax.value_and_grad(loss_of, has_aux=True)(
-          state.params
-      )
-      new_state = state.apply_gradients(grads=grads)
+      (loss, (preds, new_model_state)), grads = jax.value_and_grad(
+          loss_of, has_aux=True
+      )(state.params)
+      new_state = state.apply_gradients(
+          grads=grads, model_state=new_model_state
+      ) if mutable else state.apply_gradients(grads=grads)
       correct, total = metrics_lib.per_example_accuracy_counts(
           batch['label'], preds
       )
@@ -224,7 +241,9 @@ class Trainer:
     metric = self.alignment_metric
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
-      preds = state.apply_fn({'params': state.params}, batch['rows'])
+      preds = state.apply_fn(
+          {'params': state.params, **state.model_state}, batch['rows']
+      )
       loss = loss_obj(batch['label'], preds)
       correct, total = metrics_lib.per_example_accuracy_counts(
           batch['label'], preds

@@ -1,0 +1,32 @@
+"""The conv (BatchNorm) family trains through the shared loop."""
+import numpy as np
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import train as train_lib
+
+
+def test_conv_net_trains(tmp_path, testdata_dir):
+  params = config_lib.get_config('conv_net+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.batch_size = 8
+    params.warmup_steps = 2
+    # Shrink the trunk for CPU test speed.
+    params.conv_model = 'resnet50'
+  import deepconsensus_tpu.models.convnet as convnet
+
+  orig = convnet.RESNET_DEPTHS['resnet50']
+  convnet.RESNET_DEPTHS['resnet50'] = (1, 1, 1, 1)
+  try:
+    metrics = train_lib.run_training(
+        params=params,
+        out_dir=str(tmp_path / 'conv'),
+        train_patterns=[str(testdata_dir / 'human_1m/tf_examples/eval/*')],
+        eval_patterns=[str(testdata_dir / 'human_1m/tf_examples/eval/*')],
+        num_epochs=1,
+        eval_every=10**9,
+    )
+  finally:
+    convnet.RESNET_DEPTHS['resnet50'] = orig
+  assert np.isfinite(metrics['eval/loss'])
