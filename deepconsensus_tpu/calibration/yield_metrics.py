@@ -1,0 +1,129 @@
+"""Yield@Q benchmark metrics: empirical identity of polished reads.
+
+Implements the reference's published evaluation methodology
+(reference docs/yield_metrics.md:80-98): align polished reads to the
+truth, compute per-read empirical identity, then report — per
+predicted-quality threshold — the surviving read count, base yield,
+and the fraction meeting the identity bar (0.999 for "Q30-equivalent"
+yield). The alignment itself comes from an external aligner (pbmm2 in
+the reference); this tool consumes that BAM plus the truth FASTA.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.io import fastx
+from deepconsensus_tpu.utils import phred
+
+Cigar = constants.Cigar
+
+
+@dataclasses.dataclass
+class ReadAssessment:
+  name: str
+  length: int
+  avg_quality: float
+  matches: int
+  mismatches: int
+  insertions: int
+  deletions: int
+
+  @property
+  def identity(self) -> float:
+    aligned = self.matches + self.mismatches + self.insertions + self.deletions
+    return self.matches / aligned if aligned else 0.0
+
+
+def assess_read(
+    record: bam_lib.BamRecord, ref_seqs: Dict[str, str]
+) -> Optional[ReadAssessment]:
+  """Per-read alignment accounting from the cigar walk."""
+  if record.is_unmapped or record.is_secondary or record.is_supplementary:
+    return None
+  ref = ref_seqs.get(record.reference_name)
+  if ref is None:
+    return None
+  m = x = ins = dels = 0
+  ref_pos = record.pos
+  read_idx = 0
+  seq = record.seq.upper()
+  for op, length in zip(record.cigar_ops, record.cigar_lens):
+    if op in (Cigar.MATCH, Cigar.EQUAL, Cigar.DIFF):
+      chunk_ref = ref[ref_pos : ref_pos + length].upper()
+      for i in range(length):
+        if i < len(chunk_ref) and chunk_ref[i] == seq[read_idx + i]:
+          m += 1
+        else:
+          x += 1
+      ref_pos += length
+      read_idx += length
+    elif op in (Cigar.INS,):
+      ins += length
+      read_idx += length
+    elif op in (Cigar.SOFT_CLIP,):
+      read_idx += length
+    elif op in (Cigar.DEL, Cigar.REF_SKIP):
+      dels += length
+      ref_pos += length
+  quals = record.quals if record.quals is not None else np.empty(0)
+  return ReadAssessment(
+      name=record.qname,
+      length=len(seq),
+      avg_quality=phred.avg_phred(quals),
+      matches=m,
+      mismatches=x,
+      insertions=ins,
+      deletions=dels,
+  )
+
+
+def yield_at_thresholds(
+    reads: List[ReadAssessment],
+    quality_thresholds=(20, 30, 40),
+    identity_bar: float = 0.999,
+) -> List[Dict[str, float]]:
+  """Per quality threshold: reads kept, bases, and high-identity yield
+  (the reference's yield@emQ definition)."""
+  rows = []
+  for q in quality_thresholds:
+    kept = [r for r in reads if round(r.avg_quality, 5) >= q]
+    good = [r for r in kept if r.identity >= identity_bar]
+    rows.append({
+        'quality_threshold': q,
+        'num_reads': len(kept),
+        'num_bases': sum(r.length for r in kept),
+        'num_reads_identity_ok': len(good),
+        'yield_bases': sum(r.length for r in good),
+        'mean_identity': (
+            float(np.mean([r.identity for r in kept])) if kept else 0.0
+        ),
+    })
+  return rows
+
+
+def calculate_yield_metrics(
+    bam: str,
+    ref: str,
+    output: str,
+    quality_thresholds=(20, 30, 40),
+    identity_bar: float = 0.999,
+) -> List[Dict[str, float]]:
+  """Assesses every read and writes the yield table CSV."""
+  ref_seqs = fastx.read_fasta(ref)
+  reads = []
+  for record in bam_lib.BamReader(bam):
+    assessment = assess_read(record, ref_seqs)
+    if assessment is not None:
+      reads.append(assessment)
+  rows = yield_at_thresholds(reads, quality_thresholds, identity_bar)
+  with open(output, 'w', newline='') as f:
+    writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+    writer.writeheader()
+    writer.writerows(rows)
+  return rows

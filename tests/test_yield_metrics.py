@@ -1,0 +1,46 @@
+from deepconsensus_tpu.calibration import yield_metrics
+
+
+def test_yield_metrics_on_assessment_data(testdata_dir, tmp_path):
+  bam = str(
+      testdata_dir
+      / 'prediction_assessment/CHM13_chr20_0_200000_dc.to_truth.bam'
+  )
+  ref = str(testdata_dir / 'prediction_assessment/CHM13_chr20_0_200000.fa')
+  out = str(tmp_path / 'yield.csv')
+  rows = yield_metrics.calculate_yield_metrics(bam, ref, output=out)
+  assert [r['quality_threshold'] for r in rows] == [20, 30, 40]
+  q20 = rows[0]
+  assert q20['num_reads'] > 0
+  # Polished reads against truth: high mean identity, with a subset
+  # clearing the 0.999 yield bar.
+  assert q20['mean_identity'] > 0.9
+  assert q20['num_reads_identity_ok'] > 0
+  # Monotonic: tighter threshold keeps fewer (or equal) reads.
+  assert rows[0]['num_reads'] >= rows[1]['num_reads'] >= rows[2]['num_reads']
+  with open(out) as f:
+    header = f.readline()
+  assert 'yield_bases' in header
+
+
+def test_assess_read_counts():
+  import numpy as np
+
+  from deepconsensus_tpu.io.bam import BamRecord
+
+  rec = BamRecord(
+      qname='r1', flag=0, ref_id=0, pos=2, mapq=60,
+      cigar_ops=np.array([0, 1, 0, 2, 0], np.uint8),   # 2M 1I 2M 1D 1M
+      cigar_lens=np.array([2, 1, 2, 1, 1], np.int32),
+      seq='ACGTTA', quals=np.full(6, 30, np.int32),
+      reference_name='chr',
+  )
+  ref = {'chr': 'NNACGTAAT'}
+  out = yield_metrics.assess_read(rec, ref)
+  # ref[2:4]=AC vs AC -> 2 matches; ins G; ref[4:6]=GT vs TT -> 1 match
+  # 1 mismatch; del 1; ref[7]=A vs A -> match.
+  assert out.matches == 4
+  assert out.mismatches == 1
+  assert out.insertions == 1
+  assert out.deletions == 1
+  assert abs(out.identity - 4 / 7) < 1e-9
