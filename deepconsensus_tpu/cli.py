@@ -82,6 +82,16 @@ def _add_calibrate(sub):
   p.add_argument('--cpus', type=int, default=0)
 
 
+def _add_yield_metrics(sub):
+  p = sub.add_parser(
+      'yield_metrics', help='Yield@Q table from truth-aligned reads.')
+  p.add_argument('--bam', required=True,
+                 help='Polished reads aligned to the truth.')
+  p.add_argument('--ref', required=True, help='Truth FASTA.')
+  p.add_argument('--output', required=True, help='Output CSV.')
+  p.add_argument('--identity_bar', type=float, default=0.999)
+
+
 def _add_filter_reads(sub):
   p = sub.add_parser('filter_reads', help='Filter reads by avg quality.')
   p.add_argument('--input', required=True, help='FASTQ or BAM input.')
@@ -100,6 +110,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_train(sub)
   _add_distill(sub)
   _add_calibrate(sub)
+  _add_yield_metrics(sub)
   _add_filter_reads(sub)
   return parser
 
@@ -244,6 +255,19 @@ def _dispatch(args) -> int:
         output=args.output,
         region=args.region,
         cpus=args.cpus,
+    )
+    return 0
+
+  if args.command == 'yield_metrics':
+    from deepconsensus_tpu.calibration.yield_metrics import (
+        calculate_yield_metrics,
+    )
+
+    calculate_yield_metrics(
+        bam=args.bam,
+        ref=args.ref,
+        output=args.output,
+        identity_bar=args.identity_bar,
     )
     return 0
 

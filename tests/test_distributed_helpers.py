@@ -1,0 +1,57 @@
+import jax
+import numpy as np
+
+from deepconsensus_tpu.parallel import distributed, mesh as mesh_lib
+
+
+def test_initialize_single_process_noop():
+  distributed.initialize()  # must not raise in single-process mode
+
+
+def test_local_batch_slice_single_host():
+  sl = distributed.local_batch_slice(64)
+  assert sl == slice(0, 64)
+
+
+def test_param_shardings_tp_divisibility_guard():
+  # Odd dims replicate instead of sharding on the model axis.
+  m = mesh_lib.make_mesh(dp=4, tp=2)
+  params = {
+      'encoder': {
+          'ffn_0': {
+              'filter_layer': {
+                  'kernel': np.zeros((280, 2048), np.float32),
+                  'bias': np.zeros((2048,), np.float32),
+              },
+          },
+          'ffn_1': {
+              'filter_layer': {
+                  # Odd filter size: cannot shard over tp=2.
+                  'kernel': np.zeros((280, 2047), np.float32),
+              },
+          },
+      },
+  }
+  shardings = mesh_lib.param_shardings(m, params)
+  even = shardings['encoder']['ffn_0']['filter_layer']['kernel']
+  odd = shardings['encoder']['ffn_1']['filter_layer']['kernel']
+  assert even.spec == jax.sharding.PartitionSpec(None, 'model')
+  assert odd.spec == jax.sharding.PartitionSpec()
+
+
+def test_cli_yield_metrics(testdata_dir, tmp_path):
+  from deepconsensus_tpu import cli
+
+  out = str(tmp_path / 'yield.csv')
+  rc = cli.main([
+      'yield_metrics',
+      '--bam', str(testdata_dir
+                   / 'prediction_assessment'
+                   / 'CHM13_chr20_0_200000_dc.to_truth.bam'),
+      '--ref', str(testdata_dir
+                   / 'prediction_assessment/CHM13_chr20_0_200000.fa'),
+      '--output', out,
+  ])
+  assert rc == 0
+  with open(out) as f:
+    assert 'yield_bases' in f.readline()
