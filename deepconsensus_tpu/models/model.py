@@ -219,7 +219,16 @@ class DeepConsensusModel(nn.Module):
   def setup(self):
     p = self.params
     self.compute_dtype = jnp.dtype(p.get('dtype', 'float32'))
+    self.learn_values = 'learn_values' in p.model_name
     dt = self.compute_dtype
+    if not self.learn_values:
+      # Plain transformer: raw rows are the per-position feature vector
+      # (reference EncoderOnlyTransformer: networks.py:173-365).
+      self.encoder = EncoderStack(p, dtype=dt, name='encoder')
+      self.logits_layer = nn.Dense(
+          constants.SEQ_VOCAB_SIZE, use_bias=True, dtype=jnp.float32,
+          kernel_init=nn.initializers.glorot_uniform(), name='logits')
+      return
     if p.use_bases or p.use_ccs:
       self.bases_embedding = MaskedEmbed(
           constants.SEQ_VOCAB_SIZE, p.per_base_hidden_size, dt,
@@ -297,9 +306,17 @@ class DeepConsensusModel(nn.Module):
     deterministic = not train
     if rows.ndim == 4:
       rows = jnp.squeeze(rows, -1)
-    x = self._embed_rows(rows)
-    if p.condense_transformer_input:
-      x = self.condenser(x)
+    if self.learn_values:
+      x = self._embed_rows(rows)
+      if p.condense_transformer_input:
+        x = self.condenser(x)
+    else:
+      # Raw per-position feature vectors [B, L, total_rows], zero-padded
+      # to an even width for the positional encoding
+      # (reference: networks.py:266-306).
+      x = jnp.transpose(rows, (0, 2, 1)).astype(self.compute_dtype)
+      if p.add_pos_encoding and x.shape[-1] % 2 != 0:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, 1)))
     if p.add_pos_encoding:
       pos = sinusoidal_position_encoding(x.shape[1], x.shape[2])
       x = x + jnp.asarray(pos, x.dtype)

@@ -1,0 +1,31 @@
+"""Plain (non-learned-values) transformer: raw row features, even-padded
+hidden size, no embedding tables (reference EncoderOnlyTransformer)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+
+
+def test_plain_transformer_forward_and_params():
+  params = config_lib.get_config('transformer+test')
+  config_lib.finalize_params(params)
+  assert params.hidden_size == 86  # total_rows 85 padded even
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+  model = model_lib.get_model(params)
+  rows = jnp.asarray(
+      np.random.default_rng(0)
+      .integers(0, 5, (2, params.total_rows, 100, 1))
+      .astype(np.float32)
+  )
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  assert not any('embedding' in k for k in variables['params'])
+  preds = model.apply(variables, rows)
+  assert preds.shape == (2, 100, 5)
+  np.testing.assert_allclose(
+      np.asarray(preds.sum(-1)), np.ones((2, 100)), atol=1e-5
+  )
