@@ -114,6 +114,10 @@ class BandedSelfAttention(nn.Module):
       weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
           self.dtype
       )
+      # Expose attention maps like the reference's intermediate outputs
+      # (attention_scores_{n}: encoder_stack.py:184-187); retrieve with
+      # apply(..., capture_intermediates=True).
+      self.sow('intermediates', 'attention_scores', weights)
       weights = nn.Dropout(rate=self.dropout_rate)(
           weights, deterministic=deterministic
       )
