@@ -99,17 +99,25 @@ def stats_for_read(
       ref_pos += length
 
 
-def _process_contig(args) -> List[Dict[str, int]]:
-  """Worker: accumulates counts for one contig's records."""
-  bam, ref, contig, regions, min_mapq, dc_calibration = args
+# Per-worker state, set up once by the pool initializer so the
+# reference FASTA parses once per worker instead of once per task.
+_WORKER: Dict[str, object] = {}
+
+
+def _init_worker(ref, region_by_contig, min_mapq, dc_calibration):
+  _WORKER['ref_seqs'] = fastx.read_fasta(ref)
+  _WORKER['regions'] = region_by_contig
+  _WORKER['min_mapq'] = min_mapq
+  _WORKER['cal'] = calibration_lib.parse_calibration_string(dc_calibration)
+
+
+def _process_record_batch(records) -> List[Dict[str, int]]:
   counts = [{'M': 0, 'X': 0} for _ in range(MAX_BASEQ)]
-  ref_seqs = fastx.read_fasta(ref)
-  cal = calibration_lib.parse_calibration_string(dc_calibration)
-  for record in bam_lib.BamReader(bam):
-    if record.reference_name != contig:
-      continue
-    _accumulate_record(record, ref_seqs, {contig: regions}, cal, min_mapq,
-                       counts)
+  for record in records:
+    _accumulate_record(
+        record, _WORKER['ref_seqs'], _WORKER['regions'], _WORKER['cal'],
+        _WORKER['min_mapq'], counts,
+    )
   return counts
 
 
@@ -129,6 +137,8 @@ def _accumulate_record(record, ref_seqs, region_by_contig, cal, min_mapq,
     quals = np.round(
         calibration_lib.calibrate_quality_scores(quals.astype(np.uint8), cal)
     ).astype(np.int32)
+  # Calibration can push qualities outside the histogram range.
+  quals = np.clip(quals, 0, MAX_BASEQ - 1)
   ref_end = record.pos + int(
       np.sum(
           record.cigar_lens[
@@ -158,10 +168,11 @@ def calculate_quality_calibration(
 ) -> List[Tuple[int, int, int]]:
   """Writes CSV rows (baseq, total_match, total_mismatch); returns them.
 
-  With cpus>1, contigs fan out over a process pool (the reference pools
-  over interval round-robins: calculate_baseq_calibration.py:450-463).
+  With cpus>1, the BAM streams once in the parent and record batches
+  fan out over a process pool whose workers hold the parsed reference
+  (the reference pools over interval round-robins:
+  calculate_baseq_calibration.py:450-463).
   """
-  ref_seqs = fastx.read_fasta(ref)
   reader = bam_lib.BamReader(bam)
   contig_lengths = dict(
       zip(reader.references, reader.reference_lengths)
@@ -173,31 +184,38 @@ def calculate_quality_calibration(
   for r in regions:
     region_by_contig[r.contig].append(r)
 
-  cal = calibration_lib.parse_calibration_string(dc_calibration)
   counts = [{'M': 0, 'X': 0} for _ in range(MAX_BASEQ)]
 
-  if cpus and cpus > 1 and len(region_by_contig) > 1:
-    import multiprocessing
+  if cpus and cpus > 1:
 
-    work = [
-        (bam, ref, contig, contig_regions, min_mapq, dc_calibration)
-        for contig, contig_regions in region_by_contig.items()
-    ]
-    with multiprocessing.Pool(min(cpus, len(work))) as pool:
-      for partial in pool.imap_unordered(_process_contig, work):
+    def batches(it, size=500):
+      batch = []
+      for record in it:
+        batch.append(record)
+        if len(batch) >= size:
+          yield batch
+          batch = []
+      if batch:
+        yield batch
+
+    with multiprocessing.Pool(
+        cpus,
+        initializer=_init_worker,
+        initargs=(ref, dict(region_by_contig), min_mapq, dc_calibration),
+    ) as pool:
+      for partial in pool.imap_unordered(
+          _process_record_batch, batches(reader)
+      ):
         for q in range(MAX_BASEQ):
           counts[q]['M'] += partial[q]['M']
           counts[q]['X'] += partial[q]['X']
-    rows = [(q, counts[q]['M'], counts[q]['X']) for q in range(MAX_BASEQ)]
-    with open(output, 'w', newline='') as f:
-      writer = csv.writer(f)
-      writer.writerow(['baseq', 'total_match', 'total_mismatch'])
-      writer.writerows(rows)
-    return rows
-
-  for record in reader:
-    _accumulate_record(record, ref_seqs, region_by_contig, cal, min_mapq,
-                       counts)
+  else:
+    # Only the serial path needs the reference in the parent.
+    ref_seqs = fastx.read_fasta(ref)
+    cal = calibration_lib.parse_calibration_string(dc_calibration)
+    for record in reader:
+      _accumulate_record(record, ref_seqs, region_by_contig, cal, min_mapq,
+                         counts)
 
   rows = [
       (q, counts[q]['M'], counts[q]['X']) for q in range(MAX_BASEQ)

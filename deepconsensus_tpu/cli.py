@@ -211,9 +211,8 @@ def _dispatch(args) -> int:
   if args.command == 'distill':
     import jax
     import jax.numpy as jnp
-    import orbax.checkpoint as ocp
-    import os as os_mod
 
+    from deepconsensus_tpu.models.checkpoints import load_params
     from deepconsensus_tpu.models import config as config_lib
     from deepconsensus_tpu.models import distill as distill_lib
     from deepconsensus_tpu.models import model as model_lib
@@ -226,17 +225,13 @@ def _dispatch(args) -> int:
     rows = jnp.zeros(
         (1, teacher_params.total_rows, teacher_params.max_length, 1)
     )
-    init_vars = teacher.init(jax.random.PRNGKey(0), rows)
-    restored = ocp.StandardCheckpointer().restore(
-        os_mod.path.abspath(args.teacher_checkpoint),
-        target={'params': jax.device_get(init_vars['params']), 'step': 0},
-    )
+    teacher_weights = load_params(args.teacher_checkpoint)
     student_params = config_lib.get_config(args.config)
     config_lib.finalize_params(student_params)
     distill_lib.run_distillation(
         params=student_params,
         teacher_params_cfg=teacher_params,
-        teacher_variables={'params': restored['params']},
+        teacher_variables={'params': teacher_weights},
         out_dir=args.out_dir,
         train_patterns=args.train_path,
         eval_patterns=args.eval_path,

@@ -108,13 +108,12 @@ class ModelRunner:
     import os
 
     from deepconsensus_tpu.models import export as export_lib
+    from deepconsensus_tpu.models.checkpoints import load_params
 
     if os.path.isdir(checkpoint_path) and os.path.exists(
         os.path.join(checkpoint_path, export_lib.ARTIFACT_NAME)
     ):
       return cls.from_exported(checkpoint_path, options)
-
-    import orbax.checkpoint as ocp
 
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
@@ -123,12 +122,7 @@ class ModelRunner:
         (1, params.total_rows, params.max_length, 1), jnp.float32
     )
     variables = model.init(jax.random.PRNGKey(0), rows)
-    checkpointer = ocp.StandardCheckpointer()
-    restored = checkpointer.restore(
-        os.path.abspath(checkpoint_path),
-        target={'params': jax.device_get(variables['params']), 'step': 0},
-    )
-    return cls(params, {'params': restored['params']}, options)
+    return cls(params, {'params': load_params(checkpoint_path)}, options)
 
   @classmethod
   def from_exported(cls, export_dir: str,
@@ -246,7 +240,8 @@ def _triage_windows(
       continue
     if options.skip_windows_above:
       avg_q = phred.avg_phred(fd['ccs_base_quality_scores'])
-      if avg_q >= options.skip_windows_above:
+      # Strictly above, matching the reference (quick_inference.py:671).
+      if avg_q > options.skip_windows_above:
         to_skip.append(fd)
         counter['n_windows_quality_skipped'] += 1
         continue

@@ -1,0 +1,40 @@
+"""Checkpoint loading helpers shared by training, inference, eval,
+export, and distillation."""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+def load_params(checkpoint_path: str, params_template=None):
+  """Restores the params tree from a checkpoint, tolerating any extra
+  saved collections (step, opt_state, model_state).
+
+  Checkpoints written by Trainer.save_checkpoint always carry extra
+  keys, so the whole tree restores untyped and the params subtree is
+  selected; this trades peak host memory (optimizer moments load too)
+  for format independence.
+  """
+  import orbax.checkpoint as ocp
+
+  checkpointer = ocp.StandardCheckpointer()
+  restored = checkpointer.restore(os.path.abspath(checkpoint_path))
+  if 'params' not in restored:
+    raise KeyError(
+        f'checkpoint {checkpoint_path!r} has no params tree; '
+        f'keys: {list(restored)}'
+    )
+  return restored['params']
+
+
+def load_full_state(checkpoint_path: str) -> Dict[str, Any]:
+  """Restores the complete saved dict (params/opt_state/model_state/
+  step where present)."""
+  import orbax.checkpoint as ocp
+
+  return ocp.StandardCheckpointer().restore(
+      os.path.abspath(checkpoint_path)
+  )

@@ -137,6 +137,10 @@ class DatasetIterator:
       raise ValueError(f'no examples matched {self.patterns!r}')
     self.rows = np.stack(self._rows)
     self.labels = np.stack(self._labels) if self._labels else None
+    # Drop the per-example lists; otherwise the dataset stays resident
+    # twice for the life of training.
+    self._rows.clear()
+    self._labels.clear()
     self._rng = np.random.default_rng(self.seed)
 
   def __len__(self) -> int:

@@ -33,18 +33,9 @@ def run_evaluation(
   """Evaluates and writes <out_dir>/inference.csv; returns metrics."""
   model = model_lib.get_model(params)
   if variables is None:
-    import orbax.checkpoint as ocp
+    from deepconsensus_tpu.models.checkpoints import load_params
 
-    rows = jnp.zeros(
-        (1, params.total_rows, params.max_length, 1), jnp.float32
-    )
-    init_vars = model.init(jax.random.PRNGKey(0), rows)
-    checkpointer = ocp.StandardCheckpointer()
-    restored = checkpointer.restore(
-        os.path.abspath(checkpoint_path),
-        target={'params': jax.device_get(init_vars['params']), 'step': 0},
-    )
-    variables = {'params': restored['params']}
+    variables = {'params': load_params(checkpoint_path)}
 
   loss_obj = train_lib.make_loss(params)
   align_metric = metrics_lib.AlignmentMetric()

@@ -39,17 +39,9 @@ def export_model(
   rows_shape = (batch_size, params.total_rows, params.max_length, 1)
 
   if variables is None:
-    import orbax.checkpoint as ocp
+    from deepconsensus_tpu.models.checkpoints import load_params
 
-    init_vars = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1,) + rows_shape[1:])
-    )
-    checkpointer = ocp.StandardCheckpointer()
-    restored = checkpointer.restore(
-        os.path.abspath(checkpoint_path),
-        target={'params': jax.device_get(init_vars['params']), 'step': 0},
-    )
-    variables = {'params': restored['params']}
+    variables = {'params': load_params(checkpoint_path)}
 
   def serving_fn(rows):
     return model.apply(variables, rows)

@@ -154,6 +154,20 @@ class Trainer:
     self._best_file = os.path.join(self.out_dir, 'best_checkpoint.txt')
     self._metrics_jsonl = os.path.join(self.out_dir, 'metrics.jsonl')
     self._best_metric = -1.0
+    self._tsv_columns = None
+    # Recover best-metric and header state across restarts.
+    if os.path.exists(self._metrics_tsv):
+      with open(self._metrics_tsv) as f:
+        header = f.readline().strip().split('\t')
+        self._tsv_columns = header[1:]
+        if constants.MAIN_EVAL_METRIC_NAME in self._tsv_columns:
+          idx = 1 + self._tsv_columns.index(constants.MAIN_EVAL_METRIC_NAME)
+          for line in f:
+            parts = line.strip().split('\t')
+            try:
+              self._best_metric = max(self._best_metric, float(parts[idx]))
+            except (IndexError, ValueError):
+              continue
 
   # ---- state ---------------------------------------------------------
   def init_state(self, steps_total: int, seed: Optional[int] = None
@@ -277,7 +291,13 @@ class Trainer:
                       eval_metrics: Dict[str, float]) -> str:
     path = os.path.join(self._ckpt_dir, f'checkpoint-{step}')
     self._checkpointer.save(
-        path, {'params': jax.device_get(state.params), 'step': step},
+        path,
+        {
+            'params': jax.device_get(state.params),
+            'opt_state': jax.device_get(state.opt_state),
+            'model_state': jax.device_get(state.model_state),
+            'step': step,
+        },
         force=True,
     )
     # Block until the async write finalizes so a crash right after this
@@ -286,12 +306,18 @@ class Trainer:
     if wait is not None:
       wait()
     header_needed = not os.path.exists(self._metrics_tsv)
+    if header_needed:
+      self._tsv_columns = sorted(eval_metrics)
+      with open(self._metrics_tsv, 'a') as f:
+        f.write('checkpoint\t' + '\t'.join(self._tsv_columns) + '\n')
     with open(self._metrics_tsv, 'a') as f:
-      if header_needed:
-        f.write('checkpoint\t' + '\t'.join(sorted(eval_metrics)) + '\n')
+      # Align values to the header captured at first write; metric key
+      # sets are stable by construction (all keys always emitted).
       f.write(
           f'checkpoint-{step}\t'
-          + '\t'.join(str(eval_metrics[k]) for k in sorted(eval_metrics))
+          + '\t'.join(
+              str(eval_metrics.get(k, 'nan')) for k in self._tsv_columns
+          )
           + '\n'
       )
     main = eval_metrics.get(constants.MAIN_EVAL_METRIC_NAME, -1.0)
@@ -301,12 +327,31 @@ class Trainer:
         f.write(f'checkpoint-{step}\n')
     return path
 
-  def restore_checkpoint(self, state: TrainState, path: str) -> TrainState:
+  def restore_checkpoint(self, state: TrainState, path: str,
+                         params_only: bool = False) -> TrainState:
+    """Restores training state; full resume includes optimizer state
+    and LR-schedule position (the reference restores the whole
+    tf.train.Checkpoint: model_utils.py:511-540)."""
+    if params_only:
+      restored = self._checkpointer.restore(
+          path, target={'params': jax.device_get(state.params)},
+      )
+      return state.replace(params=restored['params'])
     restored = self._checkpointer.restore(
         path,
-        target={'params': jax.device_get(state.params), 'step': 0},
+        target={
+            'params': jax.device_get(state.params),
+            'opt_state': jax.device_get(state.opt_state),
+            'model_state': jax.device_get(state.model_state),
+            'step': 0,
+        },
     )
-    return state.replace(params=restored['params'])
+    return state.replace(
+        params=restored['params'],
+        opt_state=restored['opt_state'],
+        model_state=restored['model_state'],
+        step=jnp.asarray(restored['step']),
+    )
 
   def latest_checkpoint(self) -> Optional[str]:
     if not os.path.isdir(self._ckpt_dir):
@@ -362,7 +407,9 @@ def run_training(
   config_lib.save_params_as_json(out_dir, params)
   state = trainer.init_state(steps_total=decay_steps)
   if warm_start:
-    state = trainer.restore_checkpoint(state, warm_start)
+    # Warm start adopts weights only; optimizer starts fresh
+    # (reference --checkpoint warm start: model_train_custom_loop.py:119-124).
+    state = trainer.restore_checkpoint(state, warm_start, params_only=True)
   train_step = trainer.train_step_fn()
   eval_step = trainer.eval_step_fn()
   eval_every = eval_every or params.get('eval_every_n_steps', 3000)
@@ -387,12 +434,13 @@ def run_training(
         'eval/identity_pred': sums['identity_pred'] / batches,
         'eval/yield_over_ccs': yield_metric.result(),
     }
+    # Emit every class key unconditionally so the metric key set (and
+    # the TSV header) stays stable across evals.
     for cls in range(constants.SEQ_VOCAB_SIZE):
       total = sums.get(f'class{cls}_total', 0.0)
-      if total:
-        result[f'eval/class{cls}_accuracy'] = (
-            sums[f'class{cls}_correct'] / total
-        )
+      result[f'eval/class{cls}_accuracy'] = (
+          sums[f'class{cls}_correct'] / total if total else 0.0
+      )
     return result
 
   # Crash-resume: pick up from the newest checkpoint in out_dir
@@ -401,8 +449,7 @@ def run_training(
   latest = trainer.latest_checkpoint()
   if latest and warm_start is None:
     state = trainer.restore_checkpoint(state, latest)
-    step = int(latest.rsplit('-', 1)[1])
-    state = state.replace(step=jnp.asarray(step))
+    step = int(state.step)
 
   profile_dir = params.get('profile_dir', None)
   if profile_dir:
