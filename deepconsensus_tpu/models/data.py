@@ -223,27 +223,40 @@ class StreamingDataset:
     import threading
 
     raw_queue: 'queue_lib.Queue' = queue_lib.Queue(maxsize=4096)
+    stop = threading.Event()
 
     def producer():
       for raw in self._raw_stream():
-        raw_queue.put(raw)
+        while not stop.is_set():
+          try:
+            raw_queue.put(raw, timeout=0.5)
+            break
+          except queue_lib.Full:
+            continue
+        if stop.is_set():
+          return
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()
 
-    buffer: List[Dict[str, np.ndarray]] = []
-    fill_target = max(self.buffer_size, self.batch_size * 2)
-    while True:
-      while len(buffer) < fill_target:
-        parsed = parse_example(
-            raw_queue.get(), self.params, self.inference
-        )
-        buffer.append(parsed)
-      idx = self._rng.choice(len(buffer), self.batch_size, replace=False)
-      idx_set = set(idx.tolist())
-      chosen = [buffer[i] for i in idx]
-      buffer = [b for i, b in enumerate(buffer) if i not in idx_set]
-      batch = {'rows': np.stack([c['rows'] for c in chosen])}
-      if not self.inference:
-        batch['label'] = np.stack([c['label'] for c in chosen])
-      yield batch
+    try:
+      buffer: List[Dict[str, np.ndarray]] = []
+      fill_target = max(self.buffer_size, self.batch_size * 2)
+      while True:
+        while len(buffer) < fill_target:
+          parsed = parse_example(
+              raw_queue.get(), self.params, self.inference
+          )
+          buffer.append(parsed)
+        idx = self._rng.choice(len(buffer), self.batch_size, replace=False)
+        idx_set = set(idx.tolist())
+        chosen = [buffer[i] for i in idx]
+        buffer = [b for i, b in enumerate(buffer) if i not in idx_set]
+        batch = {'rows': np.stack([c['rows'] for c in chosen])}
+        if not self.inference:
+          batch['label'] = np.stack([c['label'] for c in chosen])
+        yield batch
+    finally:
+      # Stop the producer when the consumer abandons the iterator
+      # (GeneratorExit) so retries don't accumulate blocked threads.
+      stop.set()

@@ -388,19 +388,34 @@ def run_training(
   eval_patterns = eval_patterns or list(params.eval_path)
   num_epochs = num_epochs or params.num_epochs
 
-  train_ds = data_lib.DatasetIterator(
-      patterns=train_patterns,
-      params=params,
-      batch_size=params.batch_size,
-      seed=params.seed,
-  )
+  streaming = bool(params.get('streaming', False))
+  train_ds = None
+  if streaming:
+    # Shard-interleaved streaming with a shuffle buffer; "epochs"
+    # become fixed step counts (n_examples_train / batch). The dataset
+    # itself is constructed after checkpoint restore so the stream can
+    # be reseeded by resume position.
+    n_train = int(params.get('n_examples_train', 0) or 0)
+    if n_train < params.batch_size:
+      raise ValueError(
+          'streaming training requires params.n_examples_train (>= one '
+          'batch) to size the step budget'
+      )
+    steps_per_epoch = n_train // params.batch_size
+  else:
+    train_ds = data_lib.DatasetIterator(
+        patterns=train_patterns,
+        params=params,
+        batch_size=params.batch_size,
+        seed=params.seed,
+    )
+    steps_per_epoch = train_ds.steps_per_epoch
   eval_ds = data_lib.DatasetIterator(
       patterns=eval_patterns,
       params=params,
       batch_size=params.batch_size,
       shuffle=False,
   )
-  steps_per_epoch = train_ds.steps_per_epoch
   decay_steps = steps_per_epoch * params.get('num_epochs_for_decay',
                                              num_epochs)
   trainer = Trainer(params=params, out_dir=out_dir, mesh=mesh)
@@ -455,28 +470,51 @@ def run_training(
   if profile_dir:
     jax.profiler.start_trace(profile_dir)
 
+  def train_batches():
+    if streaming:
+      # Fold the resume step into the stream seed so a restarted run
+      # draws fresh (differently-shuffled) data instead of replaying
+      # the head of the corpus.
+      ds = data_lib.StreamingDataset(
+          patterns=train_patterns,
+          params=params,
+          batch_size=params.batch_size,
+          **({'buffer_size': params.buffer_size}
+             if 'buffer_size' in params else {}),
+          seed=params.seed + step,
+      )
+      it = iter(ds)
+      try:
+        for _ in range(max(steps_per_epoch * num_epochs - step, 0)):
+          yield next(it)
+      finally:
+        it.close()
+    else:
+      steps_to_skip = step
+      for _ in range(num_epochs):
+        for batch in train_ds.epoch():
+          if steps_to_skip > 0:
+            # Skip batches already covered by the restored checkpoint.
+            steps_to_skip -= 1
+            continue
+          yield batch
+
   final_metrics: Dict[str, float] = {}
   try:
-    steps_done_target = step
-    for epoch in range(num_epochs):
-      for batch in train_ds.epoch():
-        if steps_done_target > 0:
-          # Skip batches already covered by the restored checkpoint.
-          steps_done_target -= 1
-          continue
-        with jax.profiler.StepTraceAnnotation('train', step_num=step):
-          state, m = train_step(state, batch)
-        step += 1
-        if step % params.get('log_every_n_steps', 100) == 0:
-          m_host = {k: float(v) for k, v in m.items()}
-          m_host['train/accuracy'] = m_host['accuracy_correct'] / max(
-              m_host['accuracy_total'], 1
-          )
-          trainer.log_metrics(step, 'train', m_host)
-        if step % eval_every == 0:
-          final_metrics = run_eval(state)
-          trainer.log_metrics(step, 'eval', final_metrics)
-          trainer.save_checkpoint(state, step, final_metrics)
+    for batch in train_batches():
+      with jax.profiler.StepTraceAnnotation('train', step_num=step):
+        state, m = train_step(state, batch)
+      step += 1
+      if step % params.get('log_every_n_steps', 100) == 0:
+        m_host = {k: float(v) for k, v in m.items()}
+        m_host['train/accuracy'] = m_host['accuracy_correct'] / max(
+            m_host['accuracy_total'], 1
+        )
+        trainer.log_metrics(step, 'train', m_host)
+      if step % eval_every == 0:
+        final_metrics = run_eval(state)
+        trainer.log_metrics(step, 'eval', final_metrics)
+        trainer.save_checkpoint(state, step, final_metrics)
     final_metrics = run_eval(state)
     trainer.log_metrics(step, 'eval', final_metrics)
     trainer.save_checkpoint(state, step, final_metrics)

@@ -1,0 +1,28 @@
+"""Training via the streaming loader path."""
+import numpy as np
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import train as train_lib
+
+
+def test_streaming_training(tmp_path, testdata_dir):
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.batch_size = 8
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+    params.warmup_steps = 2
+    params.streaming = True
+    params.buffer_size = 32
+    params.n_examples_train = 64  # 8 steps per "epoch"
+  metrics = train_lib.run_training(
+      params=params,
+      out_dir=str(tmp_path / 'stream'),
+      train_patterns=[str(testdata_dir / 'human_1m/tf_examples/eval/*')],
+      eval_patterns=[str(testdata_dir / 'human_1m/tf_examples/eval/*')],
+      num_epochs=1,
+      eval_every=10**9,
+  )
+  assert np.isfinite(metrics['eval/loss'])
