@@ -1,0 +1,27 @@
+"""Per-ZMW window positions are strictly increasing (the reference's
+preprocess e2e assertion: preprocess_test.py:63-180)."""
+import collections
+
+from deepconsensus_tpu.io import tfrecord
+from deepconsensus_tpu.io.example_proto import Example
+from deepconsensus_tpu.preprocess.driver import run_preprocess
+
+
+def test_window_pos_monotonic_per_zmw(testdata_dir, tmp_path):
+  td = str(testdata_dir / 'human_1m')
+  out = str(tmp_path / '@split.tfrecord.gz')
+  run_preprocess(
+      subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+      ccs_bam=f'{td}/ccs.bam',
+      output=out,
+      ins_trim=5,
+      limit=5,
+  )
+  positions = collections.defaultdict(list)
+  for raw in tfrecord.read_tfrecords(out.replace('@split', 'inference')):
+    ex = Example.parse(raw)
+    positions[ex['name'][0]].append(ex['window_pos'][0])
+  assert positions
+  for name, pos in positions.items():
+    assert pos == sorted(pos), name
+    assert len(set(pos)) == len(pos), name
