@@ -1,0 +1,163 @@
+"""Fuzz: closed-form spacing vs a direct per-base simulation.
+
+The production spacing (preprocess/spacing.py) is a closed-form column
+model. This test re-implements the reference's per-base state machine
+(deepconsensus/preprocess/pre_lib.py:176-276,1242-1276) naively in test
+code and fuzz-compares both on random pileups, covering combinations
+the 10 testdata ZMWs can't reach (leading/trailing insertion runs,
+label-only insertions, zombie-gap tails, empty overlaps).
+"""
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import constants
+from deepconsensus_tpu.preprocess.alignment import AlignedRead
+from deepconsensus_tpu.preprocess.spacing import space_out_reads
+
+C = constants.Cigar
+M, I, D, N = int(C.MATCH), int(C.INS), int(C.DEL), int(C.REF_SKIP)
+
+
+class SimRead:
+  """Naive per-base spacing state machine (reference semantics)."""
+
+  def __init__(self, read: AlignedRead):
+    self.read = read
+    self.is_label = read.is_label
+    self.is_ins = (read.cigar == C.INS)
+    self.n = len(read)
+    self.seq_indices = np.zeros(self.n, dtype=int)
+    self.idx_seq = 0
+    self.idx_spaced = 0
+    self.done = False
+
+  def out_of_bounds(self):
+    return self.idx_seq >= self.n
+
+  def next_is_insertion(self):
+    if self.is_label:
+      while not self.out_of_bounds() and self.is_ins[self.idx_seq]:
+        self.seq_indices[self.idx_seq] = self.idx_spaced
+        self.idx_seq += 1
+        self.idx_spaced += 1
+      return False
+    return bool(self.is_ins[self.idx_seq])
+
+  def move(self):
+    self.seq_indices[self.idx_seq] = self.idx_spaced
+    self.idx_seq += 1
+    self.idx_spaced += 1
+
+  def add_gap(self):
+    self.idx_spaced += 1
+
+
+def simulate_reference(reads):
+  sims = [SimRead(r) for r in reads]
+  while not all(s.done for s in sims):
+    any_ins = False
+    for s in sims:
+      if s.done:
+        continue
+      if s.next_is_insertion():
+        any_ins = True
+        break
+    for s in sims:
+      if s.done:
+        continue
+      if any_ins and not s.next_is_insertion():
+        s.add_gap()
+      else:
+        if not s.out_of_bounds():
+          s.move()
+        if s.out_of_bounds():
+          s.done = True
+  max_len = max(s.idx_spaced for s in sims)
+  out = []
+  for s in sims:
+    bases = np.zeros(max_len, dtype=np.uint8)
+    bases[s.seq_indices] = s.read.bases
+    out.append(bases)
+  return out, max_len
+
+
+def random_read(rng, ccs_len, with_label=False, name='m/1/0'):
+  """Random aligned read over ccs coordinates [start, end)."""
+  start = int(rng.integers(0, max(ccs_len - 1, 1)))
+  end = int(rng.integers(start + 1, ccs_len + 1))
+  ops = []
+  # Optional leading insertions at the start boundary.
+  if rng.random() < 0.3:
+    ops += [I] * int(rng.integers(1, 4))
+  for _ in range(start):
+    ops.append(N)
+  pos = start
+  while pos < end:
+    r = rng.random()
+    if r < 0.55:
+      ops.append(M)
+      pos += 1
+    elif r < 0.75:
+      ops.append(D)
+      pos += 1
+    else:
+      ops.append(I)
+  if rng.random() < 0.3:
+    ops += [I] * int(rng.integers(1, 4))
+  ops = np.array(ops, dtype=np.uint8)
+  n = len(ops)
+  bases = rng.integers(1, 5, size=n).astype(np.uint8)
+  bases[(ops == D) | (ops == N)] = 0
+  is_ref = ops != I
+  ccs_idx = np.where(is_ref, np.cumsum(is_ref) - 1, -1).astype(np.int64)
+  truth_range = None
+  if with_label:
+    n_advance = int(np.isin(ops, constants.READ_ADVANCING_OPS_ARR).sum())
+    truth_range = {'contig': 'c', 'begin': 100, 'end': 100 + n_advance}
+  return AlignedRead(
+      name=name,
+      bases=bases,
+      cigar=ops,
+      pw=rng.integers(1, 50, size=n).astype(np.int32),
+      ip=rng.integers(1, 50, size=n).astype(np.int32),
+      sn=np.ones(4, np.float32),
+      strand=constants.Strand.FORWARD,
+      ccs_idx=ccs_idx,
+      truth_range=truth_range,
+  )
+
+
+def ccs_read(rng, ccs_len):
+  return AlignedRead(
+      name='m/1/ccs',
+      bases=rng.integers(1, 5, size=ccs_len).astype(np.uint8),
+      cigar=np.zeros(ccs_len, np.uint8),
+      pw=np.zeros(ccs_len, np.int32),
+      ip=np.zeros(ccs_len, np.int32),
+      sn=np.ones(4, np.float32),
+      strand=constants.Strand.UNKNOWN,
+      ccs_idx=np.arange(ccs_len, dtype=np.int64),
+      base_quality_scores=rng.integers(1, 60, ccs_len).astype(np.int64),
+  )
+
+
+@pytest.mark.parametrize('with_label', [False, True])
+@pytest.mark.parametrize('seed', range(25))
+def test_fuzz_spacing_matches_reference_simulation(seed, with_label):
+  rng = np.random.default_rng(seed + (1000 if with_label else 0))
+  ccs_len = int(rng.integers(3, 30))
+  n_subreads = int(rng.integers(1, 6))
+  reads = [
+      random_read(rng, ccs_len, name=f'm/1/{i}') for i in range(n_subreads)
+  ]
+  reads.append(ccs_read(rng, ccs_len))
+  if with_label:
+    reads.append(random_read(rng, ccs_len, with_label=True, name='label'))
+
+  sim_bases, sim_len = simulate_reference(reads)
+  spaced = space_out_reads(reads)
+  assert len(spaced[0]) == sim_len, (seed, len(spaced[0]), sim_len)
+  for i, (got, want) in enumerate(zip(spaced, sim_bases)):
+    np.testing.assert_array_equal(
+        got.bases, want, err_msg=f'seed={seed} read={i}'
+    )
