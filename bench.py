@@ -9,6 +9,8 @@ ZMW that is ~114 windows/s; vs_baseline reports our model-window
 throughput relative to that number.
 """
 import json
+import signal
+import sys
 import time
 
 import jax
@@ -17,8 +19,26 @@ import numpy as np
 
 REFERENCE_WINDOWS_PER_SEC = 114.0
 
+# Watchdog: the tunneled TPU backend can hang indefinitely (observed:
+# jax.devices() blocking for hours). Never let the bench stall the
+# harness; report the outage instead.
+WATCHDOG_SECS = 480
+
+
+def _watchdog(signum, frame):
+  print(json.dumps({
+      'metric': 'model_forward_windows_per_sec',
+      'value': 0.0,
+      'unit': 'windows/s/chip (TPU backend unresponsive: watchdog timeout)',
+      'vs_baseline': 0.0,
+  }))
+  sys.stdout.flush()
+  raise SystemExit(2)
+
 
 def main():
+  signal.signal(signal.SIGALRM, _watchdog)
+  signal.alarm(WATCHDOG_SECS)
   from deepconsensus_tpu.models import config as config_lib
   from deepconsensus_tpu.models import model as model_lib
 

@@ -1,0 +1,97 @@
+"""Property/fuzz tests for the alignment DPs.
+
+* A band at least as wide as the sequence equals the unbanded loss.
+* Soft-min loss approaches the hard-min loss as reg -> 0 (from below).
+* AlignmentMetric's optimal score matches a naive O(mn) affine-gap NW
+  implemented directly in test code.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.models import losses, metrics
+
+
+def random_case(rng, m=12):
+  y_true = rng.integers(0, 5, size=(1, m)).astype(np.float32)
+  logits = rng.normal(size=(1, m, 5)).astype(np.float32)
+  y_pred = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+  return jnp.asarray(y_true), jnp.asarray(y_pred)
+
+
+@pytest.mark.parametrize('seed', range(10))
+def test_wide_band_equals_unbanded(seed):
+  rng = np.random.default_rng(seed)
+  y_true, y_pred = random_case(rng)
+  m = y_true.shape[1]
+  full = losses.AlignmentLoss(del_cost=3.0, loss_reg=None)
+  banded = losses.AlignmentLoss(del_cost=3.0, loss_reg=None, width=m)
+  a = float(full(y_true, y_pred))
+  b = float(banded(y_true, y_pred))
+  assert a == pytest.approx(b, rel=1e-5), seed
+
+
+@pytest.mark.parametrize('seed', range(5))
+def test_soft_min_bounds_hard_min(seed):
+  rng = np.random.default_rng(100 + seed)
+  y_true, y_pred = random_case(rng)
+  hard = float(losses.AlignmentLoss(del_cost=3.0, loss_reg=None)(
+      y_true, y_pred))
+  for reg in (1.0, 0.1, 0.01):
+    soft = float(losses.AlignmentLoss(del_cost=3.0, loss_reg=reg)(
+        y_true, y_pred))
+    assert soft <= hard + 1e-4
+  tight = float(losses.AlignmentLoss(del_cost=3.0, loss_reg=0.01)(
+      y_true, y_pred))
+  assert tight == pytest.approx(hard, abs=0.2)
+
+
+def naive_affine_nw(a, b, match=2.0, mismatch=5.0, gap_open=9.0,
+                    gap_extend=4.0):
+  """Gotoh affine-gap NW score maximization (open includes first
+  extend, matching AlignmentMetric's folded gap_open)."""
+  m, n = len(a), len(b)
+  NEG = -1e9
+  Mm = np.full((m + 1, n + 1), NEG)
+  Ix = np.full((m + 1, n + 1), NEG)  # consume b (insertion)
+  Iy = np.full((m + 1, n + 1), NEG)  # consume a (deletion)
+  Mm[0, 0] = 0.0
+  for j in range(1, n + 1):
+    Ix[0, j] = -(gap_open + (j - 1) * gap_extend)
+  for i in range(1, m + 1):
+    Iy[i, 0] = -(gap_open + (i - 1) * gap_extend)
+  for i in range(1, m + 1):
+    for j in range(1, n + 1):
+      s = match if a[i - 1] == b[j - 1] else -mismatch
+      Mm[i, j] = max(Mm[i - 1, j - 1], Ix[i - 1, j - 1],
+                     Iy[i - 1, j - 1]) + s
+      Ix[i, j] = max(Mm[i, j - 1] - gap_open, Ix[i, j - 1] - gap_extend)
+      Iy[i, j] = max(Mm[i - 1, j] - gap_open, Ix[i - 1, j] - gap_open,
+                     Iy[i - 1, j] - gap_extend)
+  return max(Mm[m, n], Ix[m, n], Iy[m, n])
+
+
+@pytest.mark.parametrize('seed', range(15))
+def test_alignment_metric_score_matches_naive_nw(seed):
+  rng = np.random.default_rng(200 + seed)
+  m = 10
+  true_len = int(rng.integers(1, m + 1))
+  pred_len = int(rng.integers(1, m + 1))
+  true_seq = rng.integers(1, 5, size=true_len)
+  pred_seq = rng.integers(1, 5, size=pred_len)
+  y_true = np.zeros((1, m), np.float32)
+  y_true[0, :true_len] = true_seq
+  y_pred = np.zeros((1, m, 5), np.float32)
+  for j in range(m):
+    y_pred[0, j, pred_seq[j] if j < pred_len else 0] = 1.0
+
+  metric = metrics.AlignmentMetric()
+  v_opt, _, mv = metric.alignment(
+      jnp.asarray(y_true), jnp.asarray(y_pred)
+  )
+  want = naive_affine_nw(list(true_seq), list(pred_seq))
+  assert float(v_opt[0]) == pytest.approx(want, abs=1e-4), (
+      seed, true_seq, pred_seq
+  )
+  # Path-derived counts are consistent.
+  assert int(mv['alignment_length'][0]) >= max(true_len, pred_len)
