@@ -63,7 +63,12 @@ class InferenceOptions:
   use_ccs_smart_windows: bool = False
   max_base_quality: int = 93
   limit: int = 0
-  cpus: int = 0  # >0: featurization worker pool
+  # >0: featurization worker pool. Measured caveat: shipping featurized
+  # windows between processes is IPC-bound (~6 MB/ZMW), so on fast
+  # hosts the serial path (~20k windows/s, matching one chip's forward
+  # throughput) wins; scale across chips by sharding input BAMs into
+  # separate runs like the reference's 500-shard pattern.
+  cpus: int = 0
   # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
   end_after_stage: str = 'full'  # dc_input | tf_examples | run_model | full
   dc_calibration_values: calibration_lib.QualityCalibrationValues = (
