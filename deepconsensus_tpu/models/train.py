@@ -371,6 +371,35 @@ class Trainer:
     entry = {'step': step, 'split': split, 'time': time.time(), **metrics}
     with open(self._metrics_jsonl, 'a') as f:
       f.write(json.dumps(entry) + '\n')
+    self._write_tensorboard(step, split, metrics)
+
+  def _write_tensorboard(self, step: int, split: str,
+                         metrics: Dict[str, float]):
+    """Optional TensorBoard scalars (reference writes TB summaries:
+    model_train_custom_loop.py:164-166). No-op without tensorflow."""
+    if not hasattr(self, '_tb_writers'):
+      self._tb_writers = {}
+    if split not in self._tb_writers:
+      try:
+        import tensorflow as tf  # noqa: F401
+
+        self._tb_writers[split] = tf.summary.create_file_writer(
+            os.path.join(self.out_dir, 'tensorboard', split)
+        )
+      except ImportError:
+        self._tb_writers[split] = None
+    writer = self._tb_writers[split]
+    if writer is None:
+      return
+    import tensorflow as tf
+
+    with writer.as_default():
+      for name, value in metrics.items():
+        try:
+          tf.summary.scalar(name, float(value), step=step)
+        except (TypeError, ValueError):
+          continue
+      writer.flush()
 
 
 def run_training(

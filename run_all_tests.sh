@@ -1,0 +1,12 @@
+#!/bin/bash
+# Test runner (reference parity: run_all_tests.sh).
+#   ./run_all_tests.sh          # full suite
+#   ./run_all_tests.sh simple   # quick smoke: parity + inference e2e
+set -euo pipefail
+cd "$(dirname "$0")"
+
+if [[ "${1:-}" == "simple" ]]; then
+  exec python -m pytest \
+    tests/test_preprocess_parity.py tests/test_inference_e2e.py -q
+fi
+exec python -m pytest tests/ -q
