@@ -342,6 +342,7 @@ def run_inference(
 
     pool = multiprocessing.Pool(options.cpus)
   outcome = stitch.OutcomeCounter()
+  window_counter: collections.Counter = collections.Counter()
   timing_rows: List[Dict[str, Any]] = []
   fastq_lines = 0
 
@@ -382,15 +383,12 @@ def run_inference(
 
   try:
 
-    def flush_zmw_batch(zmw_batch):
-      nonlocal fastq_lines
-      if not zmw_batch:
-        return
+    def featurize_batch(zmw_batch):
+      """Producer-side: BAM records -> window features for one batch."""
       t0 = time.time()
       all_windows: List[Dict[str, Any]] = []
+      zmw_counters = []
       n_subreads = 0
-      if options.end_after_stage == 'dc_input':
-        return
       if pool is not None:
         results = pool.starmap(
             preprocess_zmw, [(z, options) for z in zmw_batch], chunksize=4
@@ -399,16 +397,32 @@ def run_inference(
         results = (preprocess_zmw(z, options) for z in zmw_batch)
       for zmw_input, (features, zmw_counter) in zip(zmw_batch, results):
         n_subreads += len(zmw_input[0]) - 1
-        counter.update(zmw_counter)
+        zmw_counters.append(zmw_counter)
         all_windows.extend(features)
+      return {
+          'windows': all_windows,
+          'counters': zmw_counters,
+          'n_subreads': n_subreads,
+          'n_zmws': len(zmw_batch),
+          'preprocess_time': time.time() - t0,
+      }
+
+    def consume_batch(feat):
+      nonlocal fastq_lines
+      all_windows = feat['windows']
+      n_subreads = feat['n_subreads']
+      n_batch_zmws = feat['n_zmws']
+      for zmw_counter in feat['counters']:
+        window_counter.update(zmw_counter)
       t1 = time.time()
       if options.end_after_stage == 'tf_examples':
         timing_rows.append(
-            dict(stage='preprocess', runtime=t1 - t0,
-                 n_zmws=len(zmw_batch), n_examples=len(all_windows),
+            dict(stage='preprocess', runtime=feat['preprocess_time'],
+                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
                  n_subreads=n_subreads))
         return
-      to_model, to_skip = _triage_windows(all_windows, options, counter)
+      to_model, to_skip = _triage_windows(all_windows, options,
+                                          window_counter)
       predictions = [
           process_skipped_window(fd, options) for fd in to_skip
       ]
@@ -419,7 +433,7 @@ def run_inference(
       if options.end_after_stage == 'run_model':
         timing_rows.append(
             dict(stage='run_model', runtime=t2 - t1,
-                 n_zmws=len(zmw_batch), n_examples=len(all_windows),
+                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
                  n_subreads=n_subreads))
         return
       predictions.sort(key=lambda p: (p.molecule_name, p.window_pos))
@@ -440,22 +454,72 @@ def run_inference(
           fastq_lines += 1
       t3 = time.time()
       timing_rows.extend([
-          dict(stage='preprocess', runtime=t1 - t0, n_zmws=len(zmw_batch),
-               n_examples=len(all_windows), n_subreads=n_subreads),
-          dict(stage='run_model', runtime=t2 - t1, n_zmws=len(zmw_batch),
+          dict(stage='preprocess', runtime=feat['preprocess_time'],
+               n_zmws=n_batch_zmws, n_examples=len(all_windows),
+               n_subreads=n_subreads),
+          dict(stage='run_model', runtime=t2 - t1, n_zmws=n_batch_zmws,
                n_examples=len(all_windows), n_subreads=n_subreads),
           dict(stage='stitch_and_write_fastq', runtime=t3 - t2,
-               n_zmws=len(zmw_batch), n_examples=len(all_windows),
+               n_zmws=n_batch_zmws, n_examples=len(all_windows),
                n_subreads=n_subreads),
       ])
 
-    zmw_batch = []
-    for zmw_input in feeder():
-      zmw_batch.append(zmw_input)
-      if options.batch_zmws and len(zmw_batch) >= options.batch_zmws:
-        flush_zmw_batch(zmw_batch)
+    # Cross-batch pipelining: a producer thread reads BAMs and
+    # featurizes batch N+1 while the main thread runs batch N through
+    # the model and stitcher. Counter discipline: the producer owns the
+    # feeder's `counter`; the main thread accumulates into
+    # `window_counter` and the two merge after join.
+    import queue as queue_lib
+    import threading
+
+    feat_queue: 'queue_lib.Queue' = queue_lib.Queue(maxsize=2)
+    stop = threading.Event()
+    skip_featurize = options.end_after_stage == 'dc_input'
+
+    def put(item) -> bool:
+      """Bounded put that aborts when the consumer has bailed."""
+      while not stop.is_set():
+        try:
+          feat_queue.put(item, timeout=0.5)
+          return True
+        except queue_lib.Full:
+          continue
+      return False
+
+    def producer():
+      try:
+        def flush(zmw_batch) -> bool:
+          if not zmw_batch or skip_featurize:
+            return True
+          return put(('batch', featurize_batch(zmw_batch)))
+
         zmw_batch = []
-    flush_zmw_batch(zmw_batch)
+        for zmw_input in feeder():
+          zmw_batch.append(zmw_input)
+          if options.batch_zmws and len(zmw_batch) >= options.batch_zmws:
+            if not flush(zmw_batch):
+              return
+            zmw_batch = []
+        if not flush(zmw_batch):
+          return
+        put(('done', None))
+      except BaseException as e:  # surface worker failures to the main thread
+        put(('error', e))
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+    try:
+      while True:
+        kind, payload = feat_queue.get()
+        if kind == 'done':
+          break
+        if kind == 'error':
+          raise payload
+        consume_batch(payload)
+    finally:
+      stop.set()
+      thread.join(timeout=30)
+    counter.update(window_counter)
   finally:
     close_out()
     if pool is not None:
