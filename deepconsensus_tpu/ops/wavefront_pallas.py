@@ -1,0 +1,120 @@
+"""Pallas TPU kernel: whole-DP wavefront alignment scorer.
+
+Runs the entire anti-diagonal recursion of the alignment score inside
+one VMEM-resident kernel per batch tile (fori_loop over diagonals),
+instead of a 200-step XLA while-loop whose per-step work is a few
+hundred lanes. Forward-only scorer matching ops/wavefront.alignment_scan
+semantics exactly; the differentiated training path keeps the lax.scan
+formulation (a custom-VJP kernel is future work), so this kernel serves
+hard-scoring/eval-style uses and as the measured baseline for that
+work. Validated against alignment_scan in interpret mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepconsensus_tpu.ops import wavefront
+
+Array = jnp.ndarray
+
+
+def _kernel(subs_ref, ins_ref, lens_ref, out_ref, *, m, n, del_cost,
+            loss_reg, inf):
+  # Blocks: subs [K, BT, m], ins [K+1, BT, m+1], lens [BT], out [BT].
+  bt = out_ref.shape[0]
+  i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
+
+  if loss_reg is None:
+    minop = lambda t: jnp.min(t, axis=0)
+  else:
+    reg = jnp.float32(loss_reg)
+    minop = lambda t: -reg * jax.nn.logsumexp(-t / reg, axis=0)
+
+  lens = lens_ref[:]  # [BT]
+  k_end = lens + n
+  onehot_len = (
+      jax.lax.broadcasted_iota(jnp.int32, (bt, m + 1), 1)
+      == lens[:, None]
+  ).astype(jnp.float32)
+
+  v_p2 = jnp.full((bt, m), inf, jnp.float32).at[:, 0].set(0.0)
+  ins0 = ins_ref[0]  # [BT, m+1]
+  v_p1 = jnp.concatenate(
+      [
+          ins0[:, :1],
+          jnp.full((bt, 1), del_cost, jnp.float32),
+          jnp.full((bt, m - 1), inf, jnp.float32),
+      ],
+      axis=1,
+  )
+  v_opt = jnp.full((bt,), inf, jnp.float32)
+
+  def body(k, carry):
+    v_p2, v_p1, v_opt = carry
+    subs_k = subs_ref[k - 2]  # [BT, m]
+    ins_k = ins_ref[k - 1]  # [BT, m+1]
+    j_range = k - i_range  # [1, m+1]
+    valid = (j_range >= 0) & (j_range <= n)
+
+    o_m = v_p2 + subs_k
+    o_i = v_p1 + ins_k
+    v_p2_next = v_p1[:, :-1]
+    o_d = v_p2_next + del_cost
+
+    body_vals = minop(jnp.stack([o_m, o_i[:, 1:], o_d]))  # [BT, m]
+    v_new = jnp.concatenate([o_i[:, :1], body_vals], axis=1)
+    v_new = jnp.where(valid, v_new, inf)
+    v_at_len = jnp.sum(v_new * onehot_len, axis=1)
+    v_opt = jnp.where(k_end == k, v_at_len, v_opt)
+    return v_p2_next, v_new, v_opt
+
+  _, _, v_opt = jax.lax.fori_loop(2, m + n + 1, body, (v_p2, v_p1, v_opt))
+  out_ref[:] = v_opt
+
+
+def alignment_scores(
+    subs_costs: Array,
+    ins_costs: Array,
+    del_cost: float,
+    seq_lens: Array,
+    loss_reg: Optional[float] = None,
+    inf: float = 1e9,
+    batch_tile: int = 8,
+    interpret: bool = False,
+) -> Array:
+  """Pallas twin of wavefront.alignment_scan (same args/semantics)."""
+  batch, m, n = subs_costs.shape
+  while batch % batch_tile:
+    batch_tile -= 1
+  subs_w = wavefront.wavefrontify(subs_costs)  # [K, B, m]
+  ins_w = wavefront.wavefrontify_vec(ins_costs, m + 1)  # [K+1, B, m+1]
+  k_dim = subs_w.shape[0]
+
+  grid = (batch // batch_tile,)
+  return pl.pallas_call(
+      functools.partial(
+          _kernel, m=m, n=n, del_cost=float(del_cost),
+          loss_reg=None if loss_reg is None else float(loss_reg),
+          inf=float(inf),
+      ),
+      grid=grid,
+      in_specs=[
+          pl.BlockSpec((k_dim, batch_tile, m), lambda i: (0, i, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((k_dim + 1, batch_tile, m + 1), lambda i: (0, i, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((batch_tile,), lambda i: (i,),
+                       memory_space=pltpu.VMEM),
+      ],
+      out_specs=pl.BlockSpec((batch_tile,), lambda i: (i,),
+                             memory_space=pltpu.VMEM),
+      out_shape=jax.ShapeDtypeStruct((batch,), jnp.float32),
+      interpret=interpret,
+  )(subs_w.astype(jnp.float32), ins_w.astype(jnp.float32),
+    seq_lens.astype(jnp.int32))

@@ -1,0 +1,46 @@
+"""Pallas wavefront scorer vs the lax.scan formulation (interpret)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.ops import wavefront, wavefront_pallas
+
+
+def random_costs(rng, b=8, m=20, n=20):
+  subs = jnp.asarray(rng.uniform(0, 5, size=(b, m, n)).astype(np.float32))
+  ins = jnp.asarray(rng.uniform(0, 5, size=(b, n)).astype(np.float32))
+  lens = jnp.asarray(rng.integers(1, m + 1, size=b).astype(np.int32))
+  return subs, ins, lens
+
+
+@pytest.mark.parametrize('loss_reg', [None, 0.5])
+@pytest.mark.parametrize('seed', range(3))
+def test_pallas_scorer_matches_scan(seed, loss_reg):
+  rng = np.random.default_rng(seed)
+  subs, ins, lens = random_costs(rng)
+  import jax
+
+  if loss_reg is None:
+    minop = lambda t: jnp.min(t, axis=0)
+  else:
+    # Stable soft-min, matching losses.AlignmentLoss's minop.
+    minop = lambda t: -loss_reg * jax.nn.logsumexp(-t / loss_reg, axis=0)
+  want = wavefront.alignment_scan(subs, ins, jnp.float32(3.0), lens, minop)
+  got = wavefront_pallas.alignment_scores(
+      subs, ins, 3.0, lens, loss_reg=loss_reg, interpret=True
+  )
+  np.testing.assert_allclose(
+      np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-4
+  )
+
+
+def test_pallas_scorer_non_divisible_batch():
+  rng = np.random.default_rng(9)
+  subs, ins, lens = random_costs(rng, b=6)
+  want = wavefront.alignment_scan(
+      subs, ins, jnp.float32(2.0), lens, lambda t: jnp.min(t, axis=0)
+  )
+  got = wavefront_pallas.alignment_scores(
+      subs, ins, 2.0, lens, interpret=True
+  )
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
