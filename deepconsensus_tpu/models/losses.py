@@ -65,12 +65,16 @@ class AlignmentLoss:
       width: Optional[int] = None,
       eps: float = 1e-7,
       inf: float = 1e9,
+      use_pallas: bool = False,
   ):
     self.del_cost = del_cost
     self.loss_reg = loss_reg
     self.width = width
     self.eps = eps
     self.inf = inf
+    # Forward-only Pallas scorer (ops/wavefront_pallas); scoring paths
+    # only — gradients require the scan formulation.
+    self.use_pallas = use_pallas
 
   def per_example(self, y_true: Array, y_pred: Array) -> Array:
     """[B] loss values for y_true [B, m] ints and y_pred [B, n, V]."""
@@ -91,6 +95,13 @@ class AlignmentLoss:
       minop = lambda t: -reg * jax.nn.logsumexp(-t / reg, axis=0)
 
     if self.width is None:
+      if self.use_pallas:
+        from deepconsensus_tpu.ops import wavefront_pallas
+
+        return wavefront_pallas.alignment_scores(
+            subs_costs, ins_costs, self.del_cost, seq_lens,
+            loss_reg=self.loss_reg, inf=self.inf,
+        )
       return wavefront.alignment_scan(
           subs_costs, ins_costs, del_cost, seq_lens, minop, self.inf
       )
