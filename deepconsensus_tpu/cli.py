@@ -209,7 +209,6 @@ def _dispatch(args) -> int:
     return 0
 
   if args.command == 'distill':
-    import jax
     import jax.numpy as jnp
 
     from deepconsensus_tpu.models.checkpoints import load_params

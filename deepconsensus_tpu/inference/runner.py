@@ -23,13 +23,12 @@ import itertools
 import json
 import logging
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepconsensus_tpu import constants
 from deepconsensus_tpu.calibration import lib as calibration_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import data as data_lib

@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 import gzip
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, Tuple
 
 
 def _open(path: str, mode: str = 'rt'):

@@ -8,7 +8,7 @@ lightweight shuffling loader that feeds jax.device_put directly.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterator, List, Optional, Sequence, Union
+from typing import Dict, Iterator, List, Sequence, Union
 
 import ml_collections
 import numpy as np

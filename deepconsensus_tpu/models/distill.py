@@ -8,11 +8,9 @@ the teacher runs inference-only. Both models share one jitted step.
 """
 from __future__ import annotations
 
-import os
 from typing import Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import ml_collections
 
 from deepconsensus_tpu.models import config as config_lib

@@ -12,7 +12,6 @@ import os
 from typing import Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import ml_collections
 
 from deepconsensus_tpu import constants

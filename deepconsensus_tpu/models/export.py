@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 from typing import Callable, Dict, Optional, Tuple
 
 import jax

@@ -13,7 +13,7 @@ are [m+1, n+1]; anti-diagonal k holds cells (i, k-i).
 """
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -12,7 +12,7 @@ work. Validated against alignment_scan in interpret mode.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

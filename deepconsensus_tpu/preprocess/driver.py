@@ -13,8 +13,6 @@ import multiprocessing
 import os
 from typing import Dict, List, Optional, Tuple
 
-import numpy as np
-
 from deepconsensus_tpu import constants
 from deepconsensus_tpu.io.tfrecord import TFRecordWriter
 from deepconsensus_tpu.preprocess.feeder import create_proc_feeder

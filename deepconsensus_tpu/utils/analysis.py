@@ -7,11 +7,10 @@ dicts, pretty-print base-level diffs, and summarize error k-mers.
 from __future__ import annotations
 
 import collections
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Tuple
 
 import numpy as np
 
-from deepconsensus_tpu import constants
 from deepconsensus_tpu.utils import phred
 
 
