@@ -1,0 +1,34 @@
+"""bench.py supervision: result-line extraction and failure reporting."""
+import json
+import sys
+
+
+sys.path.insert(0, '/root/repo')
+import bench  # noqa: E402
+
+
+def test_find_result_line_picks_metric_json():
+  stdout = '\n'.join([
+      'WARNING: some backend log',
+      json.dumps({'metric': 'model_forward_windows_per_sec',
+                  'value': 123.0, 'unit': 'w/s', 'vs_baseline': 1.1}),
+      'I0000 shutdown notice',
+  ])
+  line = bench._find_result_line(stdout)
+  assert line is not None
+  assert json.loads(line)['value'] == 123.0
+
+
+def test_find_result_line_none_for_garbage():
+  assert bench._find_result_line('no json here\n{"not_metric": 1}') is None
+  assert bench._find_result_line('') is None
+
+
+def test_report_failure_schema(capsys):
+  rc = bench._report_failure('unit test', 3)
+  assert rc == 3
+  out = json.loads(capsys.readouterr().out)
+  assert out['metric'] == 'model_forward_windows_per_sec'
+  assert out['value'] == 0.0
+  assert 'unit test' in out['unit']
+  assert out['vs_baseline'] == 0.0
