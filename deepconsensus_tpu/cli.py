@@ -197,7 +197,7 @@ def _dispatch(args) -> int:
       if args.batch_size:
         params.batch_size = args.batch_size
     mesh = mesh_lib.make_mesh(tp=args.tp)
-    train_lib.run_training(
+    train_lib.run_training_with_retry(
         params=params,
         out_dir=args.out_dir,
         train_patterns=args.train_path,

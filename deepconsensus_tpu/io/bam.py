@@ -207,7 +207,9 @@ def parse_record(data: bytes, references: List[str]) -> BamRecord:
   seq = _NIBBLE_LUT[nibbles[:l_seq]].tobytes().decode('ascii')
   off += n_seq_bytes
   quals_raw = np.frombuffer(data, dtype=np.uint8, count=l_seq, offset=off)
-  if l_seq and quals_raw[0] == 0xFF:
+  # htslib marks absent qualities with 0xFF in EVERY byte; a legitimate
+  # first qual of 0xFF alone must not be treated as missing.
+  if l_seq and quals_raw[0] == 0xFF and np.all(quals_raw == 0xFF):
     quals = None
   else:
     quals = quals_raw.astype(np.int32)

@@ -75,6 +75,13 @@ def tf_name_to_flax_path(name: str) -> Optional[FlaxPath]:
   if not m:
     return None
   layer, sublayer, rest = int(m.group(1)), int(m.group(2)), m.group(3)
+  wrapper = 'attention_wrapper' if sublayer == 0 else 'ffn_wrapper'
+  # Pre-LN checkpoints (rezero=False) store a per-sublayer LayerNorm
+  # (reference encoder_stack.py:62) instead of the rezero alpha.
+  mm = re.fullmatch(r'layer_norm/(gamma|beta)', rest)
+  if mm:
+    part = 'scale' if mm.group(1) == 'gamma' else 'bias'
+    return ('encoder', f'{wrapper}_{layer}', 'layer_norm', part)
   if sublayer == 0:  # attention
     if rest == 'alpha':
       return ('encoder', f'attention_wrapper_{layer}', 'alpha')

@@ -449,9 +449,17 @@ def run_training(
   trainer = Trainer(params=params, out_dir=out_dir, mesh=mesh)
   config_lib.save_params_as_json(out_dir, params)
   state = trainer.init_state(steps_total=decay_steps)
-  if warm_start:
+  if warm_start and trainer.latest_checkpoint() is not None:
+    logging.getLogger(__name__).warning(
+        'warm_start=%s ignored: %s already has checkpoints; resuming '
+        'from the latest instead', warm_start, out_dir,
+    )
+  if warm_start and trainer.latest_checkpoint() is None:
     # Warm start adopts weights only; optimizer starts fresh
     # (reference --checkpoint warm start: model_train_custom_loop.py:119-124).
+    # Applies only to the very first start: once this run has its own
+    # checkpoints, crash-resume below must win or a preempted
+    # warm-started run would restart from step 0.
     state = trainer.restore_checkpoint(state, warm_start, params_only=True)
   train_step = trainer.train_step_fn()
   eval_step = trainer.eval_step_fn()
@@ -488,9 +496,12 @@ def run_training(
 
   # Crash-resume: pick up from the newest checkpoint in out_dir
   # (reference resumable training: model_utils.py:511-540).
+  # The out_dir's own latest checkpoint always wins over warm_start:
+  # warm_start seeds only the very first start, so a preempted
+  # warm-started run resumes its own progress instead of resetting.
   step = 0
   latest = trainer.latest_checkpoint()
-  if latest and warm_start is None:
+  if latest:
     state = trainer.restore_checkpoint(state, latest)
     step = int(state.step)
 

@@ -43,7 +43,8 @@ bool scan_blocks(const uint8_t* data, size_t len, std::vector<Block>* blocks,
     while (extra + 4 <= extra_end) {
       const uint8_t si1 = data[extra], si2 = data[extra + 1];
       const uint16_t slen = data[extra + 2] | (data[extra + 3] << 8);
-      if (si1 == 'B' && si2 == 'C' && slen == 2) {
+      if (si1 == 'B' && si2 == 'C' && slen == 2 &&
+          extra + 6 <= extra_end) {
         bsize = (data[extra + 4] | (data[extra + 5] << 8)) + 1;
       }
       extra += 4 + slen;
@@ -151,10 +152,11 @@ void dc_free(uint8_t* ptr) { free(ptr); }
 
 // crc32c (Castagnoli), software table implementation, for TFRecord
 // framing without per-byte Python cost.
+// Eagerly initialized: ctypes releases the GIL during calls, so a
+// lazily built table would race between Python threads.
 static uint32_t kCrcTable[256];
-static bool crc_init_done = false;
 
-static void crc_init() {
+static bool crc_init() {
   for (uint32_t i = 0; i < 256; ++i) {
     uint32_t crc = i;
     for (int j = 0; j < 8; ++j) {
@@ -162,11 +164,12 @@ static void crc_init() {
     }
     kCrcTable[i] = crc;
   }
-  crc_init_done = true;
+  return true;
 }
+static const bool kCrcInit = crc_init();
 
 uint32_t dc_crc32c(const uint8_t* data, size_t len, uint32_t seed) {
-  if (!crc_init_done) crc_init();
+  (void)kCrcInit;
   uint32_t crc = seed ^ 0xFFFFFFFFu;
   for (size_t i = 0; i < len; ++i) {
     crc = kCrcTable[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);

@@ -131,3 +131,59 @@ def test_inference_with_worker_pool(tmp_path, testdata_dir):
       runner=runner,
   )
   assert counters['n_zmw_pass'] == 2
+
+
+def test_warm_start_does_not_override_resume(tmp_path, testdata_dir):
+  """A preempted warm-started run must resume its own latest
+  checkpoint, not reload the warm-start weights at step 0."""
+  params = tiny_params()
+  out_dir = str(tmp_path / 'warm_resume')
+  patterns = [str(testdata_dir / 'human_1m/tf_examples/eval/*')]  # 65 ex
+  train_lib.run_training(
+      params=params, out_dir=out_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=1, eval_every=10**9,
+  )
+  ckpt_dir = os.path.join(out_dir, 'checkpoints')
+  steps = sorted(
+      int(n.split('-')[1]) for n in os.listdir(ckpt_dir)
+      if n.startswith('checkpoint-') and not n.endswith('-tmp')
+  )
+  first_final = steps[-1]
+  warm = os.path.join(ckpt_dir, f'checkpoint-{first_final}')
+  # Restart with warm_start set (as run_training_with_retry would).
+  # eval_every=3 would produce a checkpoint at step 3 if training
+  # wrongly restarted from 0.
+  train_lib.run_training(
+      params=params, out_dir=out_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=2, eval_every=3, warm_start=warm,
+  )
+  steps2 = sorted(
+      int(n.split('-')[1]) for n in os.listdir(ckpt_dir)
+      if n.startswith('checkpoint-') and not n.endswith('-tmp')
+  )
+  new_steps = [s for s in steps2 if s not in steps]
+  assert new_steps and all(s > first_final for s in new_steps), steps2
+
+
+def test_cli_train_uses_retry_wrapper(monkeypatch, tmp_path):
+  """`dctpu train` survives a transient UNAVAILABLE (VERDICT r1 #6)."""
+  from deepconsensus_tpu import cli
+
+  calls = []
+
+  def fake_run_training(*args, **kwargs):
+    calls.append(kwargs)
+    if len(calls) == 1:
+      raise RuntimeError('UNAVAILABLE: TPU worker preempted')
+    return {'eval/loss': 0.5}
+
+  monkeypatch.setattr(train_lib, 'run_training', fake_run_training)
+  rc = cli.main([
+      'train', '--out_dir', str(tmp_path / 'cli_out'),
+      '--train_path', 'unused', '--eval_path', 'unused',
+      '--num_epochs', '1',
+  ])
+  assert rc == 0
+  assert len(calls) == 2
