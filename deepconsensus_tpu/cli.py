@@ -45,6 +45,12 @@ def _add_run(sub):
   p.add_argument('--dc_calibration', default=None)
   p.add_argument('--ccs_calibration', default='skip')
   p.add_argument('--limit', type=int, default=0)
+  p.add_argument('--dp', type=int, default=0,
+                 help='Shard the window batch over this many devices '
+                 '(0 = single device).')
+  p.add_argument('--cpus', type=int, default=0,
+                 help='Featurization worker processes (0 or 1 = '
+                 'in-process; tensors travel via shared memory).')
 
 
 def _add_train(sub):
@@ -59,6 +65,12 @@ def _add_train(sub):
   p.add_argument('--checkpoint', help='Warm-start checkpoint.')
   p.add_argument('--tp', type=int, default=1,
                  help='Tensor-parallel mesh size.')
+  p.add_argument('--coordinator_address',
+                 help='host:port of process 0 (multi-host training).')
+  p.add_argument('--num_processes', type=int,
+                 help='Total number of hosts (multi-host training).')
+  p.add_argument('--process_id', type=int,
+                 help='This host\'s index (multi-host training).')
 
 
 def _add_distill(sub):
@@ -170,6 +182,7 @@ def _dispatch(args) -> int:
         use_ccs_smart_windows=args.use_ccs_smart_windows,
         max_base_quality=args.max_base_quality,
         limit=args.limit,
+        cpus=args.cpus,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal
         ),
@@ -177,12 +190,22 @@ def _dispatch(args) -> int:
             args.ccs_calibration
         ),
     )
+    mesh = None
+    if args.dp:
+      import jax
+
+      from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+      mesh = mesh_lib.make_mesh(
+          dp=args.dp, tp=1, devices=jax.devices()[:args.dp]
+      )
     counters = runner_lib.run_inference(
         subreads_to_ccs=args.subreads_to_ccs,
         ccs_bam=args.ccs_bam,
         checkpoint=args.checkpoint,
         output=args.output,
         options=options,
+        mesh=mesh,
     )
     return 0 if counters.get('success', 0) > 0 else 1
 
@@ -196,6 +219,18 @@ def _dispatch(args) -> int:
     with params.unlocked():
       if args.batch_size:
         params.batch_size = args.batch_size
+    if (args.coordinator_address or args.num_processes
+        or args.process_id is not None):
+      # Initialize before the mesh is built so it spans all hosts
+      # (run_training's own distributed_config hook is for programmatic
+      # callers; the CLI must init before make_mesh below).
+      from deepconsensus_tpu.parallel import distributed
+
+      distributed.initialize(
+          coordinator_address=args.coordinator_address,
+          num_processes=args.num_processes,
+          process_id=args.process_id,
+      )
     mesh = mesh_lib.make_mesh(tp=args.tp)
     train_lib.run_training_with_retry(
         params=params,

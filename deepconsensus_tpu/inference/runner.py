@@ -87,12 +87,33 @@ class InferenceOptions:
 
 
 class ModelRunner:
-  """Jitted forward pass producing (bases, quality scores) per window."""
+  """Jitted forward pass producing (bases, quality scores) per window.
 
-  def __init__(self, params, variables, options: InferenceOptions):
+  With a mesh, the window batch is sharded over the mesh's data axis
+  (weights replicated), so one process drives every chip — the
+  multi-chip counterpart of the reference's shard-the-BAM pattern
+  (quick_inference.py 500-shard runs)."""
+
+  def __init__(self, params, variables, options: InferenceOptions,
+               mesh=None):
     self.params = params
     self.variables = variables
     self.options = options
+    self.mesh = mesh
+    if mesh is not None:
+      from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+      dp = mesh.shape[mesh_lib.DATA_AXIS]
+      if options.batch_size % dp:
+        raise ValueError(
+            f'batch_size={options.batch_size} not divisible by the mesh '
+            f'data axis ({dp} devices)'
+        )
+      # Replicate the weights across the mesh once; otherwise every
+      # forward re-broadcasts host arrays to all devices.
+      self.variables = jax.device_put(
+          variables, mesh_lib.replicated(mesh)
+      )
     model = model_lib.get_model(params)
 
     def forward(variables, rows):
@@ -101,11 +122,25 @@ class ModelRunner:
       max_prob = jnp.max(preds, axis=-1)
       return pred_ids, max_prob
 
-    self._forward = jax.jit(forward)
+    self._forward = self._jit_forward(forward, mesh)
+
+  @staticmethod
+  def _jit_forward(forward, mesh):
+    if mesh is None:
+      return jax.jit(forward)
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        forward,
+        in_shardings=(mesh_lib.replicated(mesh), batch_sh),
+        out_shardings=(batch_sh, batch_sh),
+    )
 
   @classmethod
   def from_checkpoint(cls, checkpoint_path: str,
-                      options: InferenceOptions) -> 'ModelRunner':
+                      options: InferenceOptions,
+                      mesh=None) -> 'ModelRunner':
     """Loads either an orbax checkpoint or an exported StableHLO
     artifact directory (the reference's SavedModel-vs-checkpoint
     detection: quick_inference.py:797-800,512-529)."""
@@ -117,16 +152,19 @@ class ModelRunner:
     if os.path.isdir(checkpoint_path) and os.path.exists(
         os.path.join(checkpoint_path, export_lib.ARTIFACT_NAME)
     ):
+      # Exported StableHLO artifacts bake in single-device execution.
+      if mesh is not None:
+        raise ValueError(
+            'mesh/--dp is not supported for exported StableHLO '
+            'artifacts (single-device execution is baked in); use an '
+            'orbax checkpoint for multi-chip inference'
+        )
       return cls.from_exported(checkpoint_path, options)
 
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
-    model = model_lib.get_model(params)
-    rows = jnp.zeros(
-        (1, params.total_rows, params.max_length, 1), jnp.float32
-    )
-    variables = model.init(jax.random.PRNGKey(0), rows)
-    return cls(params, {'params': load_params(checkpoint_path)}, options)
+    return cls(params, {'params': load_params(checkpoint_path)}, options,
+               mesh=mesh)
 
   @classmethod
   def from_exported(cls, export_dir: str,
@@ -199,6 +237,88 @@ def preprocess_zmw(
   pileup = reads_to_pileup(subreads, name, layout, window_widths)
   features = list(pileup.iter_window_features())
   return features, pileup.counter
+
+
+# Feature-dict fields shipped as plain pickled metadata by the shm
+# transport (everything except the bulk 'subreads' tensor).
+_SHM_META_FIELDS = (
+    'subreads/num_passes', 'name', 'window_pos',
+    'ccs_base_quality_scores', 'overflow', 'ec', 'np_num_passes', 'rq',
+    'rg',
+)
+
+
+def preprocess_zmw_shm(zmw_input, options: InferenceOptions):
+  """Pool-worker variant: the bulk window tensors travel through one
+  POSIX shared-memory segment per ZMW instead of the result pickle.
+
+  The pickle channel is the measured bottleneck of the worker pool
+  (~6 MB/ZMW through a pipe); with shm the pickle carries only names
+  and offsets. Returns (shm_name, window_metadata, counter); the
+  parent re-views the tensors with _features_from_shm and owns the
+  segment's lifetime (workers unregister from their resource tracker).
+  """
+  from multiprocessing import resource_tracker, shared_memory
+
+  features, counter = preprocess_zmw(zmw_input, options)
+  total = sum(f['subreads'].nbytes for f in features)
+  if not total:
+    return None, [{k: f[k] for k in _SHM_META_FIELDS} for f in features
+                  ], counter
+  shm = shared_memory.SharedMemory(create=True, size=total)
+  meta = []
+  offset = 0
+  for f in features:
+    arr = f['subreads']
+    flat = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf, offset=offset)
+    flat[...] = arr
+    entry = {k: f[k] for k in _SHM_META_FIELDS}
+    # bq values fit int16 (-1..93); int64 would dominate the metadata
+    # pickle (~120 KB/ZMW of the ~130 KB total).
+    entry['ccs_base_quality_scores'] = (
+        entry['ccs_base_quality_scores'].astype(np.int16)
+    )
+    entry['_shape'] = arr.shape
+    entry['_dtype'] = arr.dtype.str
+    entry['_offset'] = offset
+    offset += arr.nbytes
+    meta.append(entry)
+  name = shm.name
+  shm.close()
+  # The worker's resource tracker would unlink the segment when the
+  # worker exits; ownership transfers to the parent instead.
+  try:
+    resource_tracker.unregister(f'/{name}', 'shared_memory')
+  except Exception:  # pragma: no cover - tracker internals shifted
+    pass
+  return name, meta, counter
+
+
+def _features_from_shm(result):
+  """Parent-side inverse of preprocess_zmw_shm.
+
+  Returns (features, counter, shm_handle_or_None); the caller must
+  close+unlink the handle once the features are consumed.
+  """
+  from multiprocessing import shared_memory
+
+  shm_name, meta, counter = result
+  shm = None
+  features = []
+  if shm_name is not None:
+    shm = shared_memory.SharedMemory(name=shm_name)
+  for entry in meta:
+    f = {k: entry[k] for k in _SHM_META_FIELDS}
+    f['ccs_base_quality_scores'] = (
+        f['ccs_base_quality_scores'].astype(np.int64)
+    )
+    if shm is not None:
+      f['subreads'] = np.ndarray(
+          entry['_shape'], np.dtype(entry['_dtype']), buffer=shm.buf,
+          offset=entry['_offset'],
+      )
+    features.append(f)
+  return features, counter, shm
 
 
 def process_skipped_window(
@@ -308,6 +428,7 @@ def run_inference(
     options: Optional[InferenceOptions] = None,
     runner: Optional[ModelRunner] = None,
     ccs_fasta: Optional[str] = None,
+    mesh=None,
 ) -> Dict[str, Any]:
   """Full inference pipeline; returns the counters dict
   (reference run(): quick_inference.py:794-963)."""
@@ -315,7 +436,7 @@ def run_inference(
   if runner is None:
     if checkpoint is None:
       raise ValueError('need checkpoint or runner')
-    runner = ModelRunner.from_checkpoint(checkpoint, options)
+    runner = ModelRunner.from_checkpoint(checkpoint, options, mesh=mesh)
   params = runner.params
   options.max_passes = params.max_passes
   options.max_length = params.max_length
@@ -387,11 +508,44 @@ def run_inference(
       t0 = time.time()
       all_windows: List[Dict[str, Any]] = []
       zmw_counters = []
+      shm_handles = []
       n_subreads = 0
       if pool is not None:
-        results = pool.starmap(
-            preprocess_zmw, [(z, options) for z in zmw_batch], chunksize=4
+        # Bulk tensors travel via shared memory; the result pickle
+        # carries only names/offsets (the pipe was the bottleneck).
+        raw = pool.starmap(
+            preprocess_zmw_shm, [(z, options) for z in zmw_batch],
+            chunksize=4,
         )
+        results = []
+        try:
+          for r in raw:
+            features, zmw_counter, shm = _features_from_shm(r)
+            results.append((features, zmw_counter))
+            if shm is not None:
+              shm_handles.append(shm)
+        except BaseException:
+          # Workers unregistered the segments from their resource
+          # tracker, so this is the only cleanup: unlink every segment
+          # named in raw (attached or not) before propagating.
+          from multiprocessing import shared_memory
+
+          attached = {s.name for s in shm_handles}
+          for shm in shm_handles:
+            try:
+              shm.close()
+              shm.unlink()
+            except OSError:
+              pass
+          for r in raw:
+            if r[0] is not None and r[0] not in attached:
+              try:
+                leaked = shared_memory.SharedMemory(name=r[0])
+                leaked.close()
+                leaked.unlink()
+              except OSError:
+                pass
+          raise
       else:
         results = (preprocess_zmw(z, options) for z in zmw_batch)
       for zmw_input, (features, zmw_counter) in zip(zmw_batch, results):
@@ -404,9 +558,25 @@ def run_inference(
           'n_subreads': n_subreads,
           'n_zmws': len(zmw_batch),
           'preprocess_time': time.time() - t0,
+          'shm_handles': shm_handles,
       }
 
+    def release_shm(feat):
+      for shm in feat.get('shm_handles', ()):
+        try:
+          shm.close()
+          shm.unlink()
+        except (FileNotFoundError, OSError):
+          pass
+      feat['shm_handles'] = []
+
     def consume_batch(feat):
+      try:
+        _consume_batch(feat)
+      finally:
+        release_shm(feat)
+
+    def _consume_batch(feat):
       nonlocal fastq_lines
       all_windows = feat['windows']
       n_subreads = feat['n_subreads']
@@ -490,7 +660,13 @@ def run_inference(
         def flush(zmw_batch) -> bool:
           if not zmw_batch or skip_featurize:
             return True
-          return put(('batch', featurize_batch(zmw_batch)))
+          feat = featurize_batch(zmw_batch)
+          ok = put(('batch', feat))
+          if not ok:
+            # Consumer bailed mid-flight: this batch will never be
+            # consumed, and its shm segments have no other owner.
+            release_shm(feat)
+          return ok
 
         zmw_batch = []
         for zmw_input in feeder():
@@ -518,6 +694,11 @@ def run_inference(
     finally:
       stop.set()
       thread.join(timeout=30)
+      # Release any featurized batches still queued (error paths).
+      while not feat_queue.empty():
+        kind, payload = feat_queue.get_nowait()
+        if kind == 'batch':
+          release_shm(payload)
     counter.update(window_counter)
   finally:
     close_out()

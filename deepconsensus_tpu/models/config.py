@@ -244,6 +244,10 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   # TPU-native execution knobs (not in the reference).
   params.dtype = 'bfloat16'          # compute dtype; params stay float32
   params.use_pallas_attention = False
+  # Route AlignmentLoss through the whole-DP Pallas wavefront kernels
+  # (forward scorer + custom-VJP backward) instead of the lax.scan DP.
+  # Only applies when band_width is None (the training default).
+  params.use_pallas_wavefront = False
   params.dp_axis = 'data'            # mesh axis names
   params.tp_axis = 'model'
   params.eval_every_n_steps = 3000

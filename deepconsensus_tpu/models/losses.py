@@ -72,8 +72,8 @@ class AlignmentLoss:
     self.width = width
     self.eps = eps
     self.inf = inf
-    # Forward-only Pallas scorer (ops/wavefront_pallas); scoring paths
-    # only — gradients require the scan formulation.
+    # Whole-DP Pallas kernels (ops/wavefront_pallas): forward scorer +
+    # custom-VJP backward, so training differentiates through Pallas.
     self.use_pallas = use_pallas
 
   def per_example(self, y_true: Array, y_pred: Array) -> Array:
@@ -98,9 +98,9 @@ class AlignmentLoss:
       if self.use_pallas:
         from deepconsensus_tpu.ops import wavefront_pallas
 
-        return wavefront_pallas.alignment_scores(
-            subs_costs, ins_costs, self.del_cost, seq_lens,
-            loss_reg=self.loss_reg, inf=self.inf,
+        return wavefront_pallas.alignment_scores_vjp(
+            subs_costs, ins_costs, seq_lens, self.del_cost,
+            self.loss_reg, self.inf,
         )
       return wavefront.alignment_scan(
           subs_costs, ins_costs, del_cost, seq_lens, minop, self.inf

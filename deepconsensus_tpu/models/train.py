@@ -122,6 +122,7 @@ def make_loss(params: ml_collections.ConfigDict) -> losses_lib.AlignmentLoss:
       del_cost=params.del_cost,
       loss_reg=params.loss_reg,
       width=width,
+      use_pallas=params.get('use_pallas_wavefront', False),
   )
 
 
@@ -248,6 +249,29 @@ class Trainer:
       return mesh_lib.batch_sharding(self.mesh)
     return mesh_lib.replicated(self.mesh)
 
+  def globalize_batch(self, batch):
+    """Multi-host batch assembly: every host loads the SAME global
+    batch (same files, same seed), takes its `local_batch_slice`, and
+    the slices are stitched into one globally-sharded array
+    (reference reaches pods via TPUStrategy's per-replica dataset:
+    model_train_custom_loop.py:333-343). No-op single-process."""
+    if jax.process_count() == 1:
+      return batch
+    from deepconsensus_tpu.parallel import distributed
+
+    spec = self._batch_sharding().spec
+    if not len(spec):  # replicated: all hosts feed identical arrays
+      return {
+          k: distributed.host_local_to_global(self.mesh, spec, v)
+          for k, v in batch.items()
+      }
+    n = next(iter(batch.values())).shape[0]
+    sl = distributed.local_batch_slice(n)
+    return {
+        k: distributed.host_local_to_global(self.mesh, spec, v[sl])
+        for k, v in batch.items()
+    }
+
   def eval_step_fn(self):
     loss_obj = self.loss_fn
     params_cfg = self.params
@@ -289,6 +313,8 @@ class Trainer:
   def save_checkpoint(self, state: TrainState, step: int,
                       eval_metrics: Dict[str, float]) -> str:
     path = os.path.join(self._ckpt_dir, f'checkpoint-{step}')
+    # Multi-host: EVERY process calls save — orbax's multihost protocol
+    # barriers across processes and writes from the primary only.
     self._checkpointer.save(
         path,
         {
@@ -304,6 +330,9 @@ class Trainer:
     wait = getattr(self._checkpointer, 'wait_until_finished', None)
     if wait is not None:
       wait()
+    if jax.process_index() != 0:
+      # Metric sidecars (TSV, best-checkpoint) have one writer.
+      return path
     header_needed = not os.path.exists(self._metrics_tsv)
     if header_needed:
       self._tsv_columns = sorted(eval_metrics)
@@ -367,6 +396,8 @@ class Trainer:
     return os.path.join(self._ckpt_dir, f'checkpoint-{max(steps)}')
 
   def log_metrics(self, step: int, split: str, metrics: Dict[str, float]):
+    if jax.process_index() != 0:
+      return
     entry = {'step': step, 'split': split, 'time': time.time(), **metrics}
     with open(self._metrics_jsonl, 'a') as f:
       f.write(json.dumps(entry) + '\n')
@@ -410,8 +441,21 @@ def run_training(
     mesh=None,
     eval_every: Optional[int] = None,
     warm_start: Optional[str] = None,
+    distributed_config: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, float]:
-  """End-to-end training driver. Returns final eval metrics."""
+  """End-to-end training driver. Returns final eval metrics.
+
+  Multi-host: pass distributed_config (coordinator_address,
+  num_processes, process_id — or {} for pod auto-detection) to
+  initialize jax.distributed before the mesh is built; every host then
+  feeds its local slice of the global batch (globalize_batch) and only
+  process 0 writes checkpoints/metrics. out_dir must be shared (or at
+  least readable) across hosts for crash-resume.
+  """
+  if distributed_config is not None:
+    from deepconsensus_tpu.parallel import distributed
+
+    distributed.initialize(**distributed_config)
   train_patterns = train_patterns or list(params.train_path)
   eval_patterns = eval_patterns or list(params.eval_path)
   num_epochs = num_epochs or params.num_epochs
@@ -470,6 +514,7 @@ def run_training(
     batches = 0
     yield_metric = metrics_lib.YieldOverCCS()
     for batch in eval_ds.epoch():
+      batch = trainer.globalize_batch(batch)
       out = {k: float(v) for k, v in eval_step(state, batch).items()}
       yield_metric.update(out['identity_ccs'], out['identity_pred'])
       for k, v in out.items():
@@ -541,6 +586,7 @@ def run_training(
   final_metrics: Dict[str, float] = {}
   try:
     for batch in train_batches():
+      batch = trainer.globalize_batch(batch)
       with jax.profiler.StepTraceAnnotation('train', step_num=step):
         state, m = train_step(state, batch)
       step += 1
@@ -560,6 +606,13 @@ def run_training(
   finally:
     if profile_dir:
       jax.profiler.stop_trace()
+  if jax.process_count() > 1:
+    # Writes happen on process 0 only; without this sync the other
+    # hosts exit first and the distributed shutdown barrier times out
+    # while process 0 is still checkpointing.
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices('dc_tpu_end_of_training')
   return final_metrics
 
 

@@ -1,13 +1,15 @@
-"""Pallas TPU kernel: whole-DP wavefront alignment scorer.
+"""Pallas TPU kernels: whole-DP wavefront alignment scorer + custom VJP.
 
 Runs the entire anti-diagonal recursion of the alignment score inside
 one VMEM-resident kernel per batch tile (fori_loop over diagonals),
 instead of a 200-step XLA while-loop whose per-step work is a few
-hundred lanes. Forward-only scorer matching ops/wavefront.alignment_scan
-semantics exactly; the differentiated training path keeps the lax.scan
-formulation (a custom-VJP kernel is future work), so this kernel serves
-hard-scoring/eval-style uses and as the measured baseline for that
-work. Validated against alignment_scan in interpret mode.
+hundred lanes. `alignment_scores` is the forward scorer matching
+ops/wavefront.alignment_scan semantics exactly; `alignment_scores_vjp`
+wraps it in a jax.custom_vjp whose backward is a second whole-DP kernel
+(forward-rows recompute into VMEM scratch + reverse adjoint sweep), so
+AlignmentLoss trains through Pallas end-to-end (the reference trains
+through this DP: losses_and_metrics.py:346-411). Validated against
+alignment_scan values and jax.grad in interpret mode.
 """
 from __future__ import annotations
 
@@ -24,17 +26,50 @@ from deepconsensus_tpu.ops import wavefront
 Array = jnp.ndarray
 
 
+def _make_minop(loss_reg):
+  if loss_reg is None:
+    return lambda t: jnp.min(t, axis=0)
+  reg = jnp.float32(loss_reg)
+  return lambda t: -reg * jax.nn.logsumexp(-t / reg, axis=0)
+
+
+def _init_rows(bt, m, ins0, del_cost, inf):
+  """DP rows V[0], V[1] as full [BT, m+1] vectors (cells (i, k-i))."""
+  row0 = jnp.concatenate(
+      [jnp.zeros((bt, 1), jnp.float32),
+       jnp.full((bt, m), inf, jnp.float32)], axis=1,
+  )
+  row1 = jnp.concatenate(
+      [ins0[:, :1],
+       jnp.full((bt, 1), del_cost, jnp.float32),
+       jnp.full((bt, m - 1), inf, jnp.float32)], axis=1,
+  )
+  return row0, row1
+
+
+def _dp_step(k, v_p2, v_p1, subs_k, ins_k, *, i_range, n, del_cost,
+             minop, inf):
+  """One anti-diagonal update, shared by the forward scorer and the
+  backward kernel's recompute pass (drift here would silently decouple
+  loss values from gradients)."""
+  valid = (k - i_range >= 0) & (k - i_range <= n)
+  o_m = v_p2 + subs_k
+  o_i = v_p1 + ins_k
+  v_p2_next = v_p1[:, :-1]
+  o_d = v_p2_next + del_cost
+  body_vals = minop(jnp.stack([o_m, o_i[:, 1:], o_d]))
+  v_new = jnp.where(
+      valid, jnp.concatenate([o_i[:, :1], body_vals], axis=1), inf
+  )
+  return v_p2_next, v_new
+
+
 def _kernel(subs_ref, ins_ref, lens_ref, out_ref, *, m, n, del_cost,
             loss_reg, inf):
   # Blocks: subs [K, BT, m], ins [K+1, BT, m+1], lens [BT], out [BT].
   bt = out_ref.shape[0]
   i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
-
-  if loss_reg is None:
-    minop = lambda t: jnp.min(t, axis=0)
-  else:
-    reg = jnp.float32(loss_reg)
-    minop = lambda t: -reg * jax.nn.logsumexp(-t / reg, axis=0)
+  minop = _make_minop(loss_reg)
 
   lens = lens_ref[:]  # [BT]
   k_end = lens + n
@@ -43,38 +78,22 @@ def _kernel(subs_ref, ins_ref, lens_ref, out_ref, *, m, n, del_cost,
       == lens[:, None]
   ).astype(jnp.float32)
 
-  v_p2 = jnp.full((bt, m), inf, jnp.float32).at[:, 0].set(0.0)
-  ins0 = ins_ref[0]  # [BT, m+1]
-  v_p1 = jnp.concatenate(
-      [
-          ins0[:, :1],
-          jnp.full((bt, 1), del_cost, jnp.float32),
-          jnp.full((bt, m - 1), inf, jnp.float32),
-      ],
-      axis=1,
-  )
+  row0, row1 = _init_rows(bt, m, ins_ref[0], del_cost, inf)
   v_opt = jnp.full((bt,), inf, jnp.float32)
 
   def body(k, carry):
     v_p2, v_p1, v_opt = carry
-    subs_k = subs_ref[k - 2]  # [BT, m]
-    ins_k = ins_ref[k - 1]  # [BT, m+1]
-    j_range = k - i_range  # [1, m+1]
-    valid = (j_range >= 0) & (j_range <= n)
-
-    o_m = v_p2 + subs_k
-    o_i = v_p1 + ins_k
-    v_p2_next = v_p1[:, :-1]
-    o_d = v_p2_next + del_cost
-
-    body_vals = minop(jnp.stack([o_m, o_i[:, 1:], o_d]))  # [BT, m]
-    v_new = jnp.concatenate([o_i[:, :1], body_vals], axis=1)
-    v_new = jnp.where(valid, v_new, inf)
+    v_p2_next, v_new = _dp_step(
+        k, v_p2, v_p1, subs_ref[k - 2], ins_ref[k - 1],
+        i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
+    )
     v_at_len = jnp.sum(v_new * onehot_len, axis=1)
     v_opt = jnp.where(k_end == k, v_at_len, v_opt)
     return v_p2_next, v_new, v_opt
 
-  _, _, v_opt = jax.lax.fori_loop(2, m + n + 1, body, (v_p2, v_p1, v_opt))
+  _, _, v_opt = jax.lax.fori_loop(
+      2, m + n + 1, body, (row0[:, :m], row1, v_opt)
+  )
   out_ref[:] = v_opt
 
 
@@ -118,3 +137,208 @@ def alignment_scores(
       interpret=interpret,
   )(subs_w.astype(jnp.float32), ins_w.astype(jnp.float32),
     seq_lens.astype(jnp.int32))
+
+
+def _unwavefrontify(t_w: Array, n: int) -> Array:
+  """Inverse of wavefront.wavefrontify: [K, B, m] -> [B, m, n] with
+  out[b, i, j] = t_w[i+j, b, i] (the forward map is one-to-one)."""
+  _, _, m = t_w.shape
+  i = jnp.arange(m)[:, None]
+  j = jnp.arange(n)[None, :]
+  return jnp.transpose(t_w, (1, 0, 2))[:, i + j, i]
+
+
+def _unwavefrontify_vec_grad(v_w: Array, n: int) -> Array:
+  """Adjoint of wavefront.wavefrontify_vec: [K2, B, L] -> [B, n].
+
+  The forward broadcasts v[b, j] to every slot (k=i+j, i), so the
+  adjoint sums over i: out[b, j] = sum_i v_w[i+j, b, i].
+  """
+  _, _, length = v_w.shape
+  i = jnp.arange(length)[:, None]
+  j = jnp.arange(n)[None, :]
+  return jnp.sum(jnp.transpose(v_w, (1, 0, 2))[:, i + j, i], axis=1)
+
+
+def _soft_weights(t: Array, loss_reg):
+  """d minop / d t for the [3, BT, m] option stack (softmax of -t/reg;
+  even split among ties for the hard min, matching reduce_min's JVP)."""
+  if loss_reg is None:
+    tmin = jnp.min(t, axis=0, keepdims=True)
+    eq = (t == tmin).astype(jnp.float32)
+    return eq / jnp.sum(eq, axis=0, keepdims=True)
+  return jax.nn.softmax(-t / jnp.float32(loss_reg), axis=0)
+
+
+def _bwd_kernel(subs_ref, ins_ref, lens_ref, g_ref, dsubs_ref, dins_ref,
+                rows_ref, *, m, n, del_cost, loss_reg, inf):
+  # Blocks: subs [K, BT, m], ins [K+1, BT, m+1], lens [BT], g [BT];
+  # outputs dsubs [K, BT, m], dins [K+1, BT, m+1];
+  # scratch rows [m+n+1, BT, m+1] holds every DP row V[k].
+  bt = g_ref.shape[0]
+  i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
+  lens = lens_ref[:]
+  k_end = lens + n
+  onehot_len = (
+      jax.lax.broadcasted_iota(jnp.int32, (bt, m + 1), 1) == lens[:, None]
+  ).astype(jnp.float32)
+
+  minop = _make_minop(loss_reg)
+
+  # Pass 1: forward recompute, materializing all rows in VMEM.
+  row0, row1 = _init_rows(bt, m, ins_ref[0], del_cost, inf)
+  rows_ref[0] = row0
+  rows_ref[1] = row1
+
+  def fwd_body(k, carry):
+    v_p2, v_p1 = carry  # [BT, m], [BT, m+1]
+    v_p2_next, v_new = _dp_step(
+        k, v_p2, v_p1, subs_ref[k - 2], ins_ref[k - 1],
+        i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
+    )
+    rows_ref[k] = v_new
+    return v_p2_next, v_new
+
+  jax.lax.fori_loop(2, m + n + 1, fwd_body, (row0[:, :m], row1))
+
+  # Pass 2: reverse adjoint sweep. Carry holds the adjoints of rows
+  # V[k] and V[k-1]; step k spreads dV[k] onto its three predecessors
+  # weighted by the (recomputed) soft-min weights and emits the cost
+  # gradients for diagonal k.
+  g = g_ref[:]
+  zeros_row = jnp.zeros((bt, m + 1), jnp.float32)
+
+  def bwd_body(idx, carry):
+    dA, dB = carry  # adjoints of V[k], V[k-1]
+    k = m + n - idx
+    valid = (k - i_range >= 0) & (k - i_range <= n)
+    inject = g[:, None] * onehot_len * (k_end == k)[:, None].astype(
+        jnp.float32
+    )
+    dA = jnp.where(valid, dA + inject, 0.0)
+    v_p2 = rows_ref[k - 2][:, :m]
+    v_p1 = rows_ref[k - 1]
+    subs_k = subs_ref[k - 2]
+    ins_k = ins_ref[k - 1]
+    t = jnp.stack([
+        v_p2 + subs_k,
+        v_p1[:, 1:] + ins_k[:, 1:],
+        v_p1[:, :-1] + del_cost,
+    ])
+    w = _soft_weights(t, loss_reg)
+    dbody = dA[:, 1:]
+    d_m = w[0] * dbody
+    d_i1 = w[1] * dbody
+    d_d = w[2] * dbody
+    dsubs_ref[k - 2] = d_m
+    dins_row = jnp.concatenate([dA[:, :1], d_i1], axis=1)
+    dins_ref[k - 1] = dins_row
+    zero_col = jnp.zeros((bt, 1), jnp.float32)
+    dB_new = dB + dins_row + jnp.concatenate([d_d, zero_col], axis=1)
+    dC = jnp.concatenate([d_m, zero_col], axis=1)
+    return dB_new, dC
+
+  dV1, _ = jax.lax.fori_loop(
+      0, m + n - 1, bwd_body, (zeros_row, zeros_row)
+  )
+  # V[1][0] = ins_w[0][:, 0] is the only input-dependent init entry.
+  dins_ref[0] = jnp.concatenate(
+      [dV1[:, :1], jnp.zeros((bt, m), jnp.float32)], axis=1
+  )
+
+
+def _scores_fwd_impl(subs_costs, ins_costs, seq_lens, del_cost, loss_reg,
+                     inf, batch_tile, interpret):
+  return alignment_scores(
+      subs_costs, ins_costs, del_cost, seq_lens, loss_reg=loss_reg,
+      inf=inf, batch_tile=batch_tile, interpret=_resolve(interpret),
+  )
+
+
+def _resolve(interpret) -> bool:
+  """None -> interpret everywhere but real TPU (lets the same flag run
+  under CPU tests and the virtual mesh)."""
+  if interpret is None:
+    return jax.default_backend() != 'tpu'
+  return bool(interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def alignment_scores_vjp(
+    subs_costs: Array,
+    ins_costs: Array,
+    seq_lens: Array,
+    del_cost: float,
+    loss_reg: Optional[float],
+    inf: float = 1e9,
+    batch_tile: int = 8,
+    interpret: Optional[bool] = None,
+) -> Array:
+  """Differentiable Pallas twin of wavefront.alignment_scan.
+
+  Same scores as `alignment_scores`; gradients w.r.t. subs_costs and
+  ins_costs come from the whole-DP backward kernel.
+  """
+  return _scores_fwd_impl(
+      subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
+      batch_tile, interpret,
+  )
+
+
+def _vjp_fwd(subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
+             batch_tile, interpret):
+  out = _scores_fwd_impl(
+      subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
+      batch_tile, interpret,
+  )
+  return out, (subs_costs, ins_costs, seq_lens)
+
+
+def _vjp_bwd(del_cost, loss_reg, inf, batch_tile, interpret, res, g):
+  import numpy as np
+
+  subs_costs, ins_costs, seq_lens = res
+  batch, m, n = subs_costs.shape
+  bt = batch_tile
+  while batch % bt:
+    bt -= 1
+  subs_w = wavefront.wavefrontify(subs_costs).astype(jnp.float32)
+  ins_w = wavefront.wavefrontify_vec(ins_costs, m + 1).astype(jnp.float32)
+  k_dim = subs_w.shape[0]
+
+  d_subs_w, d_ins_w = pl.pallas_call(
+      functools.partial(
+          _bwd_kernel, m=m, n=n, del_cost=float(del_cost),
+          loss_reg=None if loss_reg is None else float(loss_reg),
+          inf=float(inf),
+      ),
+      grid=(batch // bt,),
+      in_specs=[
+          pl.BlockSpec((k_dim, bt, m), lambda i: (0, i, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((k_dim + 1, bt, m + 1), lambda i: (0, i, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((bt,), lambda i: (i,), memory_space=pltpu.VMEM),
+          pl.BlockSpec((bt,), lambda i: (i,), memory_space=pltpu.VMEM),
+      ],
+      out_specs=[
+          pl.BlockSpec((k_dim, bt, m), lambda i: (0, i, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((k_dim + 1, bt, m + 1), lambda i: (0, i, 0),
+                       memory_space=pltpu.VMEM),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((k_dim, batch, m), jnp.float32),
+          jax.ShapeDtypeStruct((k_dim + 1, batch, m + 1), jnp.float32),
+      ],
+      scratch_shapes=[pltpu.VMEM((m + n + 1, bt, m + 1), jnp.float32)],
+      interpret=_resolve(interpret),
+  )(subs_w, ins_w, seq_lens.astype(jnp.int32), g.astype(jnp.float32))
+
+  d_subs = _unwavefrontify(d_subs_w, n).astype(subs_costs.dtype)
+  d_ins = _unwavefrontify_vec_grad(d_ins_w, n).astype(ins_costs.dtype)
+  d_lens = np.zeros(seq_lens.shape, jax.dtypes.float0)
+  return d_subs, d_ins, d_lens
+
+
+alignment_scores_vjp.defvjp(_vjp_fwd, _vjp_bwd)

@@ -9,6 +9,7 @@ runs use the same code path via jax.distributed initialization.
 """
 from __future__ import annotations
 
+import logging
 import re
 from typing import Optional, Tuple
 
@@ -80,10 +81,29 @@ def param_shardings(mesh: Mesh, params):
         getattr(k, 'key', getattr(k, 'name', str(k))) for k in path
     )
     spec = _spec_for_path(path_str)
-    # Guard: only shard if dims divide; otherwise replicate.
+    # Guard: only shard if dims divide; otherwise replicate (loudly —
+    # a silent fallback would degrade tp>1 to pure DP with no signal).
     ok = True
     for dim, axis in zip(leaf.shape, spec):
       if axis is not None and dim % mesh.shape[MODEL_AXIS] != 0:
         ok = False
+    if not ok:
+      logging.getLogger(__name__).warning(
+          'param %s (shape %s) not divisible by tp=%d along %s; '
+          'replicating instead', path_str, leaf.shape,
+          mesh.shape[MODEL_AXIS], spec,
+      )
     shardings.append(NamedSharding(mesh, spec if ok else P()))
   return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def count_model_sharded(shardings) -> int:
+  """Number of params actually sharded on the model axis (observability
+  for tp>1 runs; see dryrun_multichip's assertion)."""
+  flat, _ = jax.tree_util.tree_flatten(
+      shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
+  )
+  return sum(
+      1 for s in flat
+      if isinstance(s, NamedSharding) and MODEL_AXIS in str(s.spec)
+  )

@@ -68,16 +68,18 @@ def ring_attention(
 
   q_offset = my_index * l_local
 
-  # Online softmax state; pvary marks the zeros as device-varying so
-  # the scan carry types line up with the ppermuted K/V.
-  m = jax.lax.pvary(
-      jnp.full((b, h, l_local), _NEG_INF, q.dtype), axis_name
+  # Online softmax state; pcast(to='varying') marks the zeros as
+  # device-varying so the scan carry types line up with the ppermuted
+  # K/V (pvary is deprecated in favor of pcast).
+  m = jax.lax.pcast(
+      jnp.full((b, h, l_local), _NEG_INF, q.dtype), axis_name,
+      to='varying',
   )  # running max
-  l_sum = jax.lax.pvary(
-      jnp.zeros((b, h, l_local), q.dtype), axis_name
+  l_sum = jax.lax.pcast(
+      jnp.zeros((b, h, l_local), q.dtype), axis_name, to='varying'
   )  # running denominator
-  o = jax.lax.pvary(
-      jnp.zeros((b, l_local, h, d), q.dtype), axis_name
+  o = jax.lax.pcast(
+      jnp.zeros((b, l_local, h, d), q.dtype), axis_name, to='varying'
   )  # running numerator
 
   perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]

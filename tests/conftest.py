@@ -7,6 +7,10 @@ compile checks.
 import os
 
 os.environ['JAX_PLATFORMS'] = 'cpu'
+# The reference Keras model (test_tf_forward_parity) needs Keras 2
+# (tf.keras.layers.experimental.EinsumDense, legacy add_weight); must
+# be set before the first tensorflow import anywhere in the process.
+os.environ.setdefault('TF_USE_LEGACY_KERAS', '1')
 _flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (

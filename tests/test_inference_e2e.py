@@ -142,3 +142,54 @@ def test_preprocess_driver_multiprocess_equivalence(testdata_dir, tmp_path):
     a = list(tfrecord.read_tfrecords(out_serial.replace('@split', split)))
     b = list(tfrecord.read_tfrecords(out_mp.replace('@split', split)))
     assert a == b  # imap preserves order -> byte-identical shards
+
+
+def test_mesh_inference_matches_single_device(testdata_dir, tmp_path):
+  """DP-mesh inference produces byte-identical FASTQ to single-device
+  (VERDICT r1 #4: window batch sharded over the mesh data axis)."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 64
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+
+  outputs = {}
+  for name, mesh in (
+      ('single', None),
+      ('mesh', mesh_lib.make_mesh(dp=8, tp=1)),
+  ):
+    options = runner_lib.InferenceOptions(
+        batch_size=32, batch_zmws=4, limit=3, min_quality=0
+    )
+    runner = runner_lib.ModelRunner(params, variables, options, mesh=mesh)
+    out = str(tmp_path / f'{name}.fastq')
+    counters = runner_lib.run_inference(
+        subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+        ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+        checkpoint=None,
+        output=out,
+        options=options,
+        runner=runner,
+    )
+    assert counters['n_zmw_pass'] == 3
+    with open(out, 'rb') as f:
+      outputs[name] = f.read()
+  assert outputs['single'], 'empty FASTQ output'
+  assert outputs['single'] == outputs['mesh']
+
+
+def test_mesh_batch_divisibility_guard():
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  options = runner_lib.InferenceOptions(batch_size=30)
+  mesh = mesh_lib.make_mesh(dp=8, tp=1)
+  with pytest.raises(ValueError, match='not divisible'):
+    runner_lib.ModelRunner(params, {}, options, mesh=mesh)

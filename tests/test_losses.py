@@ -64,13 +64,18 @@ ALIGNMENT_LOSS_CASES = [
 ]
 
 
+@pytest.mark.parametrize('use_pallas', [False, True])
 @pytest.mark.parametrize(
     'sequences,del_cost,loss_reg,width,expected', ALIGNMENT_LOSS_CASES
 )
-def test_alignment_loss(sequences, del_cost, loss_reg, width, expected):
+def test_alignment_loss(sequences, del_cost, loss_reg, width, expected,
+                        use_pallas):
+  if use_pallas and width is not None:
+    pytest.skip('Pallas path covers the unbanded (training) DP only')
   y_true, y_pred = convert_seqs(sequences)
   loss = losses.AlignmentLoss(
-      del_cost=del_cost, loss_reg=loss_reg, width=width
+      del_cost=del_cost, loss_reg=loss_reg, width=width,
+      use_pallas=use_pallas,
   )
   got = float(loss(y_true, y_pred))
   assert got == pytest.approx(expected, abs=2e-2)

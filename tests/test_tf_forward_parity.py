@@ -1,0 +1,236 @@
+"""Value-level forward parity vs the reference TF model.
+
+Builds the reference EncoderOnlyLearnedValuesTransformer from
+/root/reference source (with minimal stubs for its two uninstalled
+dependencies), saves a random-weight tf.train.Checkpoint, ports it with
+port_tf_checkpoint, and asserts window-for-window forward agreement.
+This is the test VERDICT r1 #5 asked for: it fails if any kernel
+layout/transpose in the port map is wrong — and, beyond the port, it
+proves the flax forward pass is numerically the reference model.
+"""
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REFERENCE_ROOT = '/root/reference'
+
+
+def _install_stubs(tf):
+  """Registers stand-ins for `official.nlp.modeling.layers` (tf-models)
+  and `pysam`, which the reference imports but are not installed.
+
+  OnDeviceEmbedding and RelativePositionEmbedding reimplement the
+  tf-models semantics (embedding gather * scale_factor; [sin|cos]
+  timing signal); pysam only supplies BAM-spec cigar ints (0..9).
+  """
+  if 'official' in sys.modules:
+    return
+
+  class OnDeviceEmbedding(tf.keras.layers.Layer):
+
+    def __init__(self, vocab_size, embedding_width, initializer=None,
+                 scale_factor=None, **kwargs):
+      super().__init__(**kwargs)
+      self._vocab_size = vocab_size
+      self._embedding_width = embedding_width
+      self._initializer = initializer or 'glorot_uniform'
+      self._scale_factor = scale_factor
+
+    def build(self, input_shape):
+      self.embeddings = self.add_weight(
+          'embeddings',
+          shape=[self._vocab_size, self._embedding_width],
+          initializer=self._initializer,
+          dtype=tf.float32,
+      )
+      super().build(input_shape)
+
+    def call(self, inputs):
+      flat = tf.reshape(inputs, [-1])
+      emb = tf.gather(self.embeddings, tf.cast(flat, tf.int32))
+      emb = tf.reshape(
+          emb, tf.concat([tf.shape(inputs), [self._embedding_width]], 0)
+      )
+      if self._scale_factor:
+        emb *= self._scale_factor
+      return emb
+
+  class RelativePositionEmbedding(tf.keras.layers.Layer):
+
+    def __init__(self, hidden_size, min_timescale=1.0,
+                 max_timescale=1.0e4, **kwargs):
+      super().__init__(**kwargs)
+      self._hidden_size = hidden_size
+      self._min_timescale = min_timescale
+      self._max_timescale = max_timescale
+
+    def call(self, inputs, length=None):
+      if inputs is not None:
+        length = tf.shape(inputs)[1]
+      position = tf.cast(tf.range(length), tf.float32)
+      num_timescales = self._hidden_size // 2
+      log_increment = np.log(
+          self._max_timescale / self._min_timescale
+      ) / max(num_timescales - 1, 1)
+      inv_timescales = self._min_timescale * tf.exp(
+          tf.cast(tf.range(num_timescales), tf.float32) * -log_increment
+      )
+      scaled = tf.expand_dims(position, 1) * tf.expand_dims(
+          inv_timescales, 0
+      )
+      return tf.concat([tf.sin(scaled), tf.cos(scaled)], axis=1)
+
+  official = types.ModuleType('official')
+  nlp = types.ModuleType('official.nlp')
+  modeling = types.ModuleType('official.nlp.modeling')
+  layers_mod = types.ModuleType('official.nlp.modeling.layers')
+  layers_mod.OnDeviceEmbedding = OnDeviceEmbedding
+  layers_mod.RelativePositionEmbedding = RelativePositionEmbedding
+  official.nlp = nlp
+  nlp.modeling = modeling
+  modeling.layers = layers_mod
+  sys.modules.update({
+      'official': official,
+      'official.nlp': nlp,
+      'official.nlp.modeling': modeling,
+      'official.nlp.modeling.layers': layers_mod,
+  })
+
+  if 'pysam' not in sys.modules:
+    pysam = types.ModuleType('pysam')
+    for i, name in enumerate(
+        ['CMATCH', 'CINS', 'CDEL', 'CREF_SKIP', 'CSOFT_CLIP',
+         'CHARD_CLIP', 'CPAD', 'CEQUAL', 'CDIFF', 'CBACK']
+    ):
+      setattr(pysam, name, i)
+    sys.modules['pysam'] = pysam
+
+
+def _finalize_ref_params(ref_params):
+  """Reference modify_params' derivations (model_utils.py:237-355),
+  replicated here because model_utils itself imports more uninstalled
+  tf-models modules than the networks need."""
+  from deepconsensus.models import data_providers
+  from deepconsensus.models import transformer_basic_params
+
+  with ref_params.unlocked():
+    ref_params.batch_size = 4
+    ref_params.total_rows = data_providers.get_total_rows(
+        ref_params.max_passes, ref_params.use_ccs_bq
+    )
+    dim = (
+        ref_params.use_bases * ref_params.per_base_hidden_size
+        + ref_params.use_pw * ref_params.pw_hidden_size
+        + ref_params.use_ip * ref_params.ip_hidden_size
+        + ref_params.use_strand * ref_params.strand_hidden_size
+        + ref_params.use_ccs_bq * ref_params.ccs_bq_hidden_size
+    )
+    ref_params.hidden_size = (
+        ref_params.max_passes * dim
+        + ref_params.use_ccs * ref_params.per_base_hidden_size
+        + ref_params.use_ccs_bq * ref_params.ccs_bq_hidden_size
+        + ref_params.use_sn * ref_params.sn_hidden_size * 4
+    )
+    if ref_params.hidden_size % 2 != 0:
+      ref_params.hidden_size += 1
+    ref_params.default_batch_size = ref_params.batch_size
+    if ref_params.condense_transformer_input:
+      ref_params.hidden_size = ref_params.transformer_input_size
+    preset = {
+        'tiny': transformer_basic_params.TINY_PARAMS,
+        'base': transformer_basic_params.BASE_PARAMS,
+        'big': transformer_basic_params.BIG_PARAMS,
+    }[ref_params.transformer_model_size]
+    for name, value in preset.items():
+      if name not in ref_params:
+        ref_params[name] = value
+
+
+@pytest.fixture(scope='module')
+def reference_model_and_checkpoint(tmp_path_factory):
+  tf = pytest.importorskip('tensorflow')
+  _install_stubs(tf)
+  if REFERENCE_ROOT not in sys.path:
+    sys.path.insert(0, REFERENCE_ROOT)
+  from deepconsensus.models import model_configs as ref_configs
+  from deepconsensus.models import networks as ref_networks
+
+  ref_params = ref_configs.get_config('transformer_learn_values+test')
+  _finalize_ref_params(ref_params)
+  model = ref_networks.EncoderOnlyLearnedValuesTransformer(ref_params)
+
+  rng = np.random.default_rng(0)
+  rows = np.zeros((4, ref_params.total_rows, ref_params.max_length, 1),
+                  np.float32)
+  mp = ref_params.max_passes
+  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)
+  rows[:, mp:2 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 2 * mp:3 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 3 * mp:4 * mp] = rng.integers(0, 3, size=rows[:, :mp].shape)
+  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)
+  rows[:, 4 * mp + 1:] = rng.integers(
+      0, 15, size=rows[:, 4 * mp + 1:].shape)
+
+  preds_tf = model(tf.constant(rows), training=False).numpy()
+
+  prefix = str(tmp_path_factory.mktemp('tf_ckpt') / 'checkpoint-1')
+  tf.train.Checkpoint(model=model).write(prefix)
+  return ref_params, rows, preds_tf, prefix
+
+
+def test_forward_parity_after_port(reference_model_and_checkpoint):
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.models import port_tf_checkpoint as port
+
+  ref_params, rows, preds_tf, prefix = reference_model_and_checkpoint
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+  # The two configs must describe the same architecture.
+  for key in ('hidden_size', 'max_length', 'max_passes', 'num_heads',
+              'num_hidden_layers', 'filter_size', 'attn_win_size',
+              'transformer_input_size', 'per_base_hidden_size'):
+    assert params[key] == ref_params[key], key
+
+  model = model_lib.get_model(params)
+  variables = model.init(
+      jax.random.PRNGKey(0), jnp.asarray(rows[:1])
+  )
+  flax_params = jax.tree.map(np.asarray, variables['params'])
+  ported = port.port_checkpoint(prefix, flax_params)
+
+  preds_flax = np.asarray(
+      model.apply({'params': ported}, jnp.asarray(rows))
+  )
+  np.testing.assert_allclose(preds_flax, preds_tf, atol=1e-4, rtol=1e-3)
+
+
+def test_port_rejects_shape_mismatch(reference_model_and_checkpoint):
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.models import port_tf_checkpoint as port
+
+  _, _, _, prefix = reference_model_and_checkpoint
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_heads = 4  # wrong head split -> kernel shape mismatch
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  flax_params = jax.tree.map(
+      np.asarray, model.init(jax.random.PRNGKey(0), rows)['params']
+  )
+  with pytest.raises(ValueError, match='shape mismatch'):
+    port.port_checkpoint(prefix, flax_params)

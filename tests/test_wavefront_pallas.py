@@ -44,3 +44,104 @@ def test_pallas_scorer_non_divisible_batch():
       subs, ins, 2.0, lens, interpret=True
   )
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize('loss_reg', [0.1, 1.0])
+def test_pallas_vjp_grads_match_scan(loss_reg):
+  """Custom-VJP backward kernel vs jax.grad of the scan DP."""
+  import jax
+
+  rng = np.random.default_rng(3)
+  subs, ins, lens = random_costs(rng, b=8, m=14, n=14)
+  minop = lambda t: -loss_reg * jax.nn.logsumexp(-t / loss_reg, axis=0)
+
+  def scan_loss(subs, ins):
+    return jnp.sum(
+        wavefront.alignment_scan(subs, ins, jnp.float32(3.0), lens, minop)
+    )
+
+  def pallas_loss(subs, ins):
+    return jnp.sum(
+        wavefront_pallas.alignment_scores_vjp(
+            subs, ins, lens, 3.0, loss_reg, interpret=True
+        )
+    )
+
+  want_val, (want_ds, want_di) = jax.value_and_grad(
+      scan_loss, argnums=(0, 1)
+  )(subs, ins)
+  got_val, (got_ds, got_di) = jax.value_and_grad(
+      pallas_loss, argnums=(0, 1)
+  )(subs, ins)
+  np.testing.assert_allclose(
+      np.asarray(got_val), np.asarray(want_val), rtol=1e-5
+  )
+  np.testing.assert_allclose(
+      np.asarray(got_ds), np.asarray(want_ds), rtol=1e-4, atol=1e-5
+  )
+  np.testing.assert_allclose(
+      np.asarray(got_di), np.asarray(want_di), rtol=1e-4, atol=1e-5
+  )
+
+
+def test_pallas_vjp_hard_min_grads():
+  """Hard-min (loss_reg=None) grads match the scan DP's subgradient."""
+  import jax
+
+  rng = np.random.default_rng(11)
+  subs, ins, lens = random_costs(rng, b=4, m=10, n=10)
+  minop = lambda t: jnp.min(t, axis=0)
+
+  def scan_loss(subs, ins):
+    return jnp.sum(
+        wavefront.alignment_scan(subs, ins, jnp.float32(2.0), lens, minop)
+    )
+
+  def pallas_loss(subs, ins):
+    return jnp.sum(
+        wavefront_pallas.alignment_scores_vjp(
+            subs, ins, lens, 2.0, None, interpret=True
+        )
+    )
+
+  want_ds, want_di = jax.grad(scan_loss, argnums=(0, 1))(subs, ins)
+  got_ds, got_di = jax.grad(pallas_loss, argnums=(0, 1))(subs, ins)
+  np.testing.assert_allclose(
+      np.asarray(got_ds), np.asarray(want_ds), rtol=1e-4, atol=1e-6
+  )
+  np.testing.assert_allclose(
+      np.asarray(got_di), np.asarray(want_di), rtol=1e-4, atol=1e-6
+  )
+
+
+def test_alignment_loss_pallas_path_trains():
+  """AlignmentLoss(use_pallas=True) values + grads match the scan path."""
+  import jax
+
+  from deepconsensus_tpu.models import losses as losses_lib
+
+  rng = np.random.default_rng(7)
+  b, m, vocab = 8, 12, 5
+  y_true = jnp.asarray(rng.integers(0, vocab, size=(b, m)), jnp.int32)
+  logits = jnp.asarray(
+      rng.normal(size=(b, m, vocab)).astype(np.float32)
+  )
+  y_pred = jax.nn.softmax(logits)
+
+  loss_scan = losses_lib.AlignmentLoss(del_cost=10.0, loss_reg=0.1)
+  loss_pallas = losses_lib.AlignmentLoss(
+      del_cost=10.0, loss_reg=0.1, use_pallas=True
+  )
+
+  def f_scan(y_pred):
+    return loss_scan(y_true, y_pred)
+
+  def f_pallas(y_pred):
+    return loss_pallas(y_true, y_pred)
+
+  want, want_g = jax.value_and_grad(f_scan)(y_pred)
+  got, got_g = jax.value_and_grad(f_pallas)(y_pred)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+  np.testing.assert_allclose(
+      np.asarray(got_g), np.asarray(want_g), rtol=1e-4, atol=1e-5
+  )
