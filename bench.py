@@ -185,8 +185,12 @@ def main():
   # Stage 4: training throughput (full train step, batch 256), scan DP
   # vs Pallas wavefront-VJP loss. Opportunistic: the train-step compile
   # alone can take minutes on a cold cache.
-  for name, use_pallas in (('train_b256_scan', False),
-                           ('train_b256_pallas_vjp', True)):
+  for name, overrides in (
+      ('train_b256_scan', {}),
+      ('train_b256_pallas_vjp', {'use_pallas_wavefront': True}),
+      ('train_b256_pallas_attn', {'use_pallas_wavefront': True,
+                                  'use_pallas_attention': True}),
+  ):
     if budget_left() < 150:
       break
     try:
@@ -196,7 +200,8 @@ def main():
       config_lib.finalize_params(tp)
       with tp.unlocked():
         tp.batch_size = 256
-        tp.use_pallas_wavefront = use_pallas
+        for key, value in overrides.items():
+          setattr(tp, key, value)
       trainer = train_lib.Trainer(params=tp, out_dir='/tmp/dc_bench_train',
                                   mesh=None)
       state = trainer.init_state(steps_total=100)

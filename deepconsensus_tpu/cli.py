@@ -73,6 +73,19 @@ def _add_train(sub):
                  help='This host\'s index (multi-host training).')
 
 
+def _add_export(sub):
+  p = sub.add_parser(
+      'export',
+      help='Export a checkpoint as a serving artifact (StableHLO), the '
+      'counterpart of the reference convert_to_saved_model tool.',
+  )
+  p.add_argument('--checkpoint', required=True,
+                 help='Orbax checkpoint directory (with params.json).')
+  p.add_argument('--output', required=True, help='Output directory.')
+  p.add_argument('--batch_size', type=int, default=1024,
+                 help='Fixed serving batch size baked into the export.')
+
+
 def _add_distill(sub):
   p = sub.add_parser('distill', help='Distill a teacher into a student.')
   p.add_argument('--teacher_checkpoint', required=True)
@@ -121,6 +134,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_run(sub)
   _add_train(sub)
   _add_distill(sub)
+  _add_export(sub)
   _add_calibrate(sub)
   _add_yield_metrics(sub)
   _add_filter_reads(sub)
@@ -241,6 +255,17 @@ def _dispatch(args) -> int:
         mesh=mesh,
         warm_start=args.checkpoint,
     )
+    return 0
+
+  if args.command == 'export':
+    from deepconsensus_tpu.models import export as export_lib
+
+    artifact = export_lib.export_model(
+        checkpoint_path=args.checkpoint,
+        out_dir=args.output,
+        batch_size=args.batch_size,
+    )
+    print(f'exported: {artifact}')
     return 0
 
   if args.command == 'distill':

@@ -266,23 +266,30 @@ def preprocess_zmw_shm(zmw_input, options: InferenceOptions):
     return None, [{k: f[k] for k in _SHM_META_FIELDS} for f in features
                   ], counter
   shm = shared_memory.SharedMemory(create=True, size=total)
-  meta = []
-  offset = 0
-  for f in features:
-    arr = f['subreads']
-    flat = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf, offset=offset)
-    flat[...] = arr
-    entry = {k: f[k] for k in _SHM_META_FIELDS}
-    # bq values fit int16 (-1..93); int64 would dominate the metadata
-    # pickle (~120 KB/ZMW of the ~130 KB total).
-    entry['ccs_base_quality_scores'] = (
-        entry['ccs_base_quality_scores'].astype(np.int16)
-    )
-    entry['_shape'] = arr.shape
-    entry['_dtype'] = arr.dtype.str
-    entry['_offset'] = offset
-    offset += arr.nbytes
-    meta.append(entry)
+  try:
+    meta = []
+    offset = 0
+    for f in features:
+      arr = f['subreads']
+      flat = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf,
+                        offset=offset)
+      flat[...] = arr
+      entry = {k: f[k] for k in _SHM_META_FIELDS}
+      # bq values fit int16 (-1..93); int64 would dominate the metadata
+      # pickle (~120 KB/ZMW of the ~130 KB total).
+      entry['ccs_base_quality_scores'] = (
+          entry['ccs_base_quality_scores'].astype(np.int16)
+      )
+      entry['_shape'] = arr.shape
+      entry['_dtype'] = arr.dtype.str
+      entry['_offset'] = offset
+      offset += arr.nbytes
+      meta.append(entry)
+  except BaseException:
+    # Packing failed: this worker still owns the segment.
+    shm.close()
+    shm.unlink()
+    raise
   name = shm.name
   shm.close()
   # The worker's resource tracker would unlink the segment when the
@@ -292,6 +299,18 @@ def preprocess_zmw_shm(zmw_input, options: InferenceOptions):
   except Exception:  # pragma: no cover - tracker internals shifted
     pass
   return name, meta, counter
+
+
+def _pool_worker(zmw_input, options: InferenceOptions):
+  """starmap payload: never raises, so the parent always receives every
+  created shm name (a raising task would make starmap discard ALL
+  results, orphaning the successful workers' segments forever)."""
+  try:
+    return 'ok', preprocess_zmw_shm(zmw_input, options)
+  except BaseException:
+    import traceback
+
+    return 'error', traceback.format_exc()
 
 
 def _features_from_shm(result):
@@ -513,14 +532,20 @@ def run_inference(
       if pool is not None:
         # Bulk tensors travel via shared memory; the result pickle
         # carries only names/offsets (the pipe was the bottleneck).
+        # _pool_worker never raises, so starmap always returns and the
+        # parent always sees every created shm name (a raising task
+        # would discard ALL results, orphaning sibling segments).
         raw = pool.starmap(
-            preprocess_zmw_shm, [(z, options) for z in zmw_batch],
-            chunksize=4,
+            _pool_worker, [(z, options) for z in zmw_batch], chunksize=4,
         )
         results = []
         try:
-          for r in raw:
-            features, zmw_counter, shm = _features_from_shm(r)
+          for status, payload in raw:
+            if status != 'ok':
+              raise RuntimeError(
+                  f'featurization worker failed:\n{payload}'
+              )
+            features, zmw_counter, shm = _features_from_shm(payload)
             results.append((features, zmw_counter))
             if shm is not None:
               shm_handles.append(shm)
@@ -537,10 +562,11 @@ def run_inference(
               shm.unlink()
             except OSError:
               pass
-          for r in raw:
-            if r[0] is not None and r[0] not in attached:
+          for status, payload in raw:
+            if (status == 'ok' and payload[0] is not None
+                and payload[0] not in attached):
               try:
-                leaked = shared_memory.SharedMemory(name=r[0])
+                leaked = shared_memory.SharedMemory(name=payload[0])
                 leaked.close()
                 leaked.unlink()
               except OSError:

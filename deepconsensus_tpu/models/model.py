@@ -96,13 +96,26 @@ class BandedSelfAttention(nn.Module):
     key = dense('key')(x)
     value = dense('value')(x)
 
-    if self.use_pallas and deterministic:
-      # Fused VMEM kernel (no attention dropout path).
+    if self.use_pallas:
+      # Fused VMEM kernel with custom VJP, so it serves training too.
+      # Dropout uses a caller-generated bernoulli keep-mask shared by
+      # forward and backward (ops/banded_attention.py).
       from deepconsensus_tpu.ops import banded_attention as ba
 
-      out = ba.banded_attention(
-          query, key, value, self.attn_win_size or None
-      )
+      if deterministic or self.dropout_rate == 0.0:
+        out = ba.banded_attention_vjp(
+            query, key, value, self.attn_win_size or None
+        )
+      else:
+        b, l, n, _ = query.shape
+        keep_prob = 1.0 - self.dropout_rate
+        mask = jax.random.bernoulli(
+            self.make_rng('dropout'), keep_prob, (b, n, l, l)
+        ).astype(jnp.uint8)
+        out = ba.banded_attention_dropout_vjp(
+            query, key, value, mask, self.attn_win_size or None,
+            keep_prob,
+        )
     else:
       # [B, N, Lq, Lk]
       logits = jnp.einsum('BTNH,BFNH->BNFT', key, query)

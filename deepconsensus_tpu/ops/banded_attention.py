@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepconsensus_tpu.ops import pallas_util
+
 Array = jnp.ndarray
 
 _NEG = -1e9
@@ -97,3 +99,213 @@ def banded_attention(
       interpret=interpret,
   )(qb, kb, vb)
   return jnp.transpose(out.reshape(b, h, l, d), (0, 2, 1, 3))
+
+
+def _fwd_dropout_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *,
+                        attn_win_size, length, keep_prob):
+  """Forward with a precomputed dropout mask on the attention weights.
+
+  The mask is generated outside the kernel (XLA-side bernoulli): the
+  TPU in-kernel PRNG has no interpret-mode lowering, and a shared mask
+  input keeps forward/backward bit-identical by construction. The big
+  [G, L, L] logits/weights tensors still never touch HBM.
+  """
+  q = q_ref[:].astype(jnp.float32)
+  k = k_ref[:].astype(jnp.float32)
+  v = v_ref[:].astype(jnp.float32)
+  s = jax.lax.dot_general(
+      q, k, (((2,), (2,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  )
+  rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+  cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+  valid = cols < length
+  if attn_win_size is not None:
+    valid = valid & (jnp.abs(rows - cols) <= attn_win_size)
+  s = jnp.where(valid, s, _NEG)
+  m = jnp.max(s, axis=2, keepdims=True)
+  p = jnp.exp(s - m)
+  denom = jnp.sum(p, axis=2, keepdims=True)
+  w = p / denom
+  w = w * (mask_ref[:].astype(jnp.float32) / keep_prob)
+  o = jax.lax.dot_general(
+      w, v, (((2,), (1,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  )
+  o_ref[:] = o.astype(o_ref.dtype)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, dq_ref, dk_ref,
+                dv_ref, *, attn_win_size, length, keep_prob, has_mask):
+  """Backward: recompute the weights in VMEM, then the three grads.
+
+  Softmax rows: w = softmax(mask(q k^T)); dropped = w * mask/keep.
+    dv = dropped^T do
+    d(dropped) = do v^T;  dw = d(dropped) * mask/keep
+    ds = w * (dw - rowsum(dw * w))   (softmax VJP; masked cols have
+                                      w == 0, so ds == 0 there)
+    dq = ds k;  dk = ds^T q
+  """
+  q = q_ref[:].astype(jnp.float32)
+  k = k_ref[:].astype(jnp.float32)
+  v = v_ref[:].astype(jnp.float32)
+  do = do_ref[:].astype(jnp.float32)
+  s = jax.lax.dot_general(
+      q, k, (((2,), (2,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  )
+  rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+  cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+  valid = cols < length
+  if attn_win_size is not None:
+    valid = valid & (jnp.abs(rows - cols) <= attn_win_size)
+  s = jnp.where(valid, s, _NEG)
+  m = jnp.max(s, axis=2, keepdims=True)
+  p = jnp.exp(s - m)
+  denom = jnp.sum(p, axis=2, keepdims=True)
+  w = p / denom
+  if has_mask:
+    drop = mask_ref[:].astype(jnp.float32) / keep_prob
+  else:
+    drop = 1.0
+  dropped = w * drop
+  dv_ref[:] = jax.lax.dot_general(
+      dropped, do, (((1,), (1,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  ).astype(dv_ref.dtype)
+  d_dropped = jax.lax.dot_general(
+      do, v, (((2,), (2,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  )
+  dw = d_dropped * drop
+  ds = w * (dw - jnp.sum(dw * w, axis=2, keepdims=True))
+  dq_ref[:] = jax.lax.dot_general(
+      ds, k, (((2,), (1,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  ).astype(dq_ref.dtype)
+  dk_ref[:] = jax.lax.dot_general(
+      ds, q, (((1,), (1,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  ).astype(dk_ref.dtype)
+
+
+
+def _blocks(x, n, l, d):
+  return jnp.transpose(x, (0, 2, 1, 3)).reshape(n, l, d)
+
+
+def _unblocks(x, b, h, l, d):
+  return jnp.transpose(x.reshape(b, h, l, d), (0, 2, 1, 3))
+
+
+def _bwd_call(q, k, v, mask, do, attn_win_size, keep_prob, interpret,
+              group=8):
+  b, l, h, d = q.shape
+  n = b * h
+  group = min(group, n)
+  while n % group:
+    group -= 1
+  qb, kb, vb = (_blocks(x, n, l, d) for x in (q, k, v))
+  dob = _blocks(do, n, l, d)
+  has_mask = mask is not None
+  if has_mask:
+    maskb = mask.reshape(n, l, l)
+  else:
+    maskb = jnp.zeros((n, 1, 1), jnp.uint8)  # unread placeholder
+  spec = pl.BlockSpec((group, l, d), lambda i: (i, 0, 0),
+                      memory_space=pltpu.VMEM)
+  mask_spec = pl.BlockSpec(
+      (group, l, l) if has_mask else (group, 1, 1),
+      lambda i: (i, 0, 0), memory_space=pltpu.VMEM,
+  )
+  dq, dk, dv = pl.pallas_call(
+      functools.partial(
+          _bwd_kernel, attn_win_size=attn_win_size, length=l,
+          keep_prob=keep_prob, has_mask=has_mask,
+      ),
+      grid=(n // group,),
+      in_specs=[spec, spec, spec, mask_spec, spec],
+      out_specs=[spec, spec, spec],
+      out_shape=[jax.ShapeDtypeStruct((n, l, d), q.dtype)] * 3,
+      interpret=pallas_util.resolve_interpret(interpret),
+  )(qb, kb, vb, maskb, dob)
+  return tuple(_unblocks(x, b, h, l, d) for x in (dq, dk, dv))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def banded_attention_vjp(q, k, v, attn_win_size, interpret=None):
+  """Differentiable fused banded attention (no dropout).
+
+  Same semantics as banded_attention/reference_banded_attention; the
+  backward recomputes the weights in VMEM (flash-attention style).
+  """
+  return banded_attention(q, k, v, attn_win_size,
+                          interpret=pallas_util.resolve_interpret(interpret))
+
+
+def _vjp_fwd(q, k, v, attn_win_size, interpret):
+  return banded_attention_vjp(q, k, v, attn_win_size, interpret), (
+      q, k, v)
+
+
+def _vjp_bwd(attn_win_size, interpret, res, do):
+  q, k, v = res
+  return _bwd_call(q, k, v, None, do, attn_win_size, 1.0, interpret)
+
+
+banded_attention_vjp.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def banded_attention_dropout_vjp(q, k, v, mask, attn_win_size,
+                                 keep_prob, interpret=None):
+  """Differentiable fused banded attention with weight dropout.
+
+  mask: [B, H, L, L] bernoulli(keep_prob) keep-mask (uint8/bool),
+  generated by the caller so forward and backward share it exactly
+  (the unfused path's nn.Dropout semantics: weights * mask/keep_prob).
+  """
+  b, l, h, d = q.shape
+  n = b * h
+  group = min(16, n)
+  while n % group:
+    group -= 1
+  qb, kb, vb = (_blocks(x, n, l, d) for x in (q, k, v))
+  maskb = mask.reshape(n, l, l)
+  spec = pl.BlockSpec((group, l, d), lambda i: (i, 0, 0),
+                      memory_space=pltpu.VMEM)
+  mask_spec = pl.BlockSpec((group, l, l), lambda i: (i, 0, 0),
+                           memory_space=pltpu.VMEM)
+  out = pl.pallas_call(
+      functools.partial(
+          _fwd_dropout_kernel, attn_win_size=attn_win_size, length=l,
+          keep_prob=keep_prob,
+      ),
+      grid=(n // group,),
+      in_specs=[spec, spec, spec, mask_spec],
+      out_specs=spec,
+      out_shape=jax.ShapeDtypeStruct((n, l, d), q.dtype),
+      interpret=pallas_util.resolve_interpret(interpret),
+  )(qb, kb, vb, maskb)
+  return _unblocks(out, b, h, l, d)
+
+
+def _dvjp_fwd(q, k, v, mask, attn_win_size, keep_prob, interpret):
+  out = banded_attention_dropout_vjp(
+      q, k, v, mask, attn_win_size, keep_prob, interpret
+  )
+  return out, (q, k, v, mask)
+
+
+def _dvjp_bwd(attn_win_size, keep_prob, interpret, res, do):
+  import numpy as np
+
+  q, k, v, mask = res
+  dq, dk, dv = _bwd_call(
+      q, k, v, mask, do, attn_win_size, keep_prob, interpret
+  )
+  d_mask = np.zeros(mask.shape, jax.dtypes.float0)
+  return dq, dk, dv, d_mask
+
+
+banded_attention_dropout_vjp.defvjp(_dvjp_fwd, _dvjp_bwd)

@@ -1,0 +1,14 @@
+"""Shared helpers for the Pallas TPU kernels."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def resolve_interpret(interpret: Optional[bool]) -> bool:
+  """None -> interpret everywhere but real TPU, so the same flag runs
+  the kernels under CPU tests and the virtual mesh."""
+  if interpret is None:
+    return jax.default_backend() != 'tpu'
+  return bool(interpret)

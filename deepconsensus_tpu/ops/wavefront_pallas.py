@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepconsensus_tpu.ops import pallas_util
 from deepconsensus_tpu.ops import wavefront
 
 Array = jnp.ndarray
@@ -251,16 +252,10 @@ def _scores_fwd_impl(subs_costs, ins_costs, seq_lens, del_cost, loss_reg,
                      inf, batch_tile, interpret):
   return alignment_scores(
       subs_costs, ins_costs, del_cost, seq_lens, loss_reg=loss_reg,
-      inf=inf, batch_tile=batch_tile, interpret=_resolve(interpret),
+      inf=inf, batch_tile=batch_tile,
+      interpret=pallas_util.resolve_interpret(interpret),
   )
 
-
-def _resolve(interpret) -> bool:
-  """None -> interpret everywhere but real TPU (lets the same flag run
-  under CPU tests and the virtual mesh)."""
-  if interpret is None:
-    return jax.default_backend() != 'tpu'
-  return bool(interpret)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -332,7 +327,7 @@ def _vjp_bwd(del_cost, loss_reg, inf, batch_tile, interpret, res, g):
           jax.ShapeDtypeStruct((k_dim + 1, batch, m + 1), jnp.float32),
       ],
       scratch_shapes=[pltpu.VMEM((m + n + 1, bt, m + 1), jnp.float32)],
-      interpret=_resolve(interpret),
+      interpret=pallas_util.resolve_interpret(interpret),
   )(subs_w, ins_w, seq_lens.astype(jnp.int32), g.astype(jnp.float32))
 
   d_subs = _unwavefrontify(d_subs_w, n).astype(subs_costs.dtype)

@@ -27,29 +27,119 @@ def test_kernel_in_model_forward():
   import jax
   from deepconsensus_tpu.models import config as config_lib
   from deepconsensus_tpu.models import model as model_lib
-  from deepconsensus_tpu.ops import banded_attention as ba_mod
 
-  # Route the kernel through interpret mode for the CPU test.
-  orig = ba_mod.banded_attention
-  ba_mod.banded_attention = lambda q, k, v, w: orig(q, k, v, w,
-                                                    interpret=True)
-  try:
-    params = config_lib.get_config('transformer_learn_values+test')
-    config_lib.finalize_params(params)
-    with params.unlocked():
-      params.dtype = 'float32'
-      params.num_hidden_layers = 1
-      params.filter_size = 32
-    rows = jnp.zeros((2, params.total_rows, params.max_length, 1))
-    model = model_lib.get_model(params)
-    variables = model.init(jax.random.PRNGKey(0), rows)
-    base = model.apply(variables, rows)
-    with params.unlocked():
-      params.use_pallas_attention = True
-    model_p = model_lib.get_model(params)
-    fused = model_p.apply(variables, rows)
+  # Off-TPU the kernel auto-resolves to interpret mode.
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+  rows = jnp.zeros((2, params.total_rows, params.max_length, 1))
+  model = model_lib.get_model(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  base = model.apply(variables, rows)
+  with params.unlocked():
+    params.use_pallas_attention = True
+  model_p = model_lib.get_model(params)
+  fused = model_p.apply(variables, rows)
+  np.testing.assert_allclose(
+      np.asarray(fused), np.asarray(base), atol=1e-5
+  )
+
+
+@pytest.mark.parametrize('win', [12, None])
+def test_vjp_grads_match_reference(win):
+  import jax
+
+  q, k, v = make_qkv(b=2, l=24, h=2, d=16, seed=3)
+
+  def ref_loss(q, k, v):
+    out = ba.reference_banded_attention(q, k, v, win)
+    return jnp.sum(out * jnp.cos(out))
+
+  def pallas_loss(q, k, v):
+    out = ba.banded_attention_vjp(q, k, v, win, True)
+    return jnp.sum(out * jnp.cos(out))
+
+  want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+  got = jax.grad(pallas_loss, argnums=(0, 1, 2))(q, k, v)
+  for g, w in zip(got, want):
     np.testing.assert_allclose(
-        np.asarray(fused), np.asarray(base), atol=1e-5
+        np.asarray(g), np.asarray(w), atol=2e-4, rtol=1e-4
     )
-  finally:
-    ba_mod.banded_attention = orig
+
+
+def test_dropout_vjp_matches_masked_reference():
+  """With the SAME keep-mask, the fused dropout kernel must agree with
+  the unfused weights*mask/keep_prob semantics in values and grads."""
+  import jax
+
+  win = 8
+  keep_prob = 0.9
+  q, k, v = make_qkv(b=2, l=20, h=2, d=16, seed=5)
+  b, l, h, _ = q.shape
+  mask = jax.random.bernoulli(
+      jax.random.PRNGKey(7), keep_prob, (b, h, l, l)
+  ).astype(jnp.uint8)
+
+  def ref_loss(q, k, v):
+    logits = jnp.einsum('BTNH,BFNH->BNFT', k, q)
+    i = jnp.arange(l)
+    band = jnp.abs(i[:, None] - i[None, :]) <= win
+    logits = jnp.where(band[None, None], logits, -1e9)
+    weights = jax.nn.softmax(logits, axis=-1)
+    weights = weights * mask.astype(weights.dtype) / keep_prob
+    out = jnp.einsum('BNFT,BTNH->BFNH', weights, v)
+    return jnp.sum(out * jnp.cos(out))
+
+  def pallas_loss(q, k, v):
+    out = ba.banded_attention_dropout_vjp(
+        q, k, v, mask, win, keep_prob, True
+    )
+    return jnp.sum(out * jnp.cos(out))
+
+  want_val = ref_loss(q, k, v)
+  got_val = pallas_loss(q, k, v)
+  np.testing.assert_allclose(
+      np.asarray(got_val), np.asarray(want_val), rtol=1e-5
+  )
+  want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+  got = jax.grad(pallas_loss, argnums=(0, 1, 2))(q, k, v)
+  for g, w in zip(got, want):
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(w), atol=2e-4, rtol=1e-4
+    )
+
+
+def test_model_trains_with_pallas_attention():
+  """Full train step (dropout on) through the fused attention VJP."""
+  import jax
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import train as train_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.batch_size = 8
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+    params.use_pallas_attention = True
+
+  trainer = train_lib.Trainer(
+      params=params, out_dir='/tmp/dc_pallas_attn_smoke', mesh=None
+  )
+  state = trainer.init_state(steps_total=10)
+  step = trainer.train_step_fn()
+  rng = np.random.default_rng(0)
+  rows = jnp.asarray(
+      rng.uniform(0, 4, size=(8, params.total_rows, params.max_length,
+                              1)).astype(np.float32))
+  label = jnp.asarray(
+      rng.integers(0, 5, size=(8, params.max_length)), jnp.int32)
+  state, m = step(state, {'rows': rows, 'label': label})
+  l1 = float(m['loss'])
+  state, m = step(state, {'rows': rows, 'label': label})
+  assert np.isfinite(l1) and np.isfinite(float(m['loss']))
+  assert float(m['loss']) != l1  # params actually updated

@@ -122,3 +122,38 @@ def test_export_roundtrip(tmp_path):
   np.testing.assert_allclose(
       np.asarray(preds), np.asarray(direct), atol=1e-5
   )
+
+
+def test_cli_export_subcommand(tmp_path, testdata_dir):
+  """`dctpu export` produces a servable artifact from a checkpoint
+  (parity with reference convert_to_saved_model.py)."""
+  from deepconsensus_tpu import cli
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import train as train_lib
+
+  params = _params(layers=1)
+  out_dir = str(tmp_path / 'train')
+  patterns = [str(testdata_dir / 'human_1m/tf_examples/eval/*')]
+  with params.unlocked():
+    params.batch_size = 8
+  train_lib.run_training(
+      params=params, out_dir=out_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=1, eval_every=10**9,
+  )
+  ckpts = [
+      n for n in os.listdir(os.path.join(out_dir, 'checkpoints'))
+      if n.startswith('checkpoint-') and not n.endswith('-tmp')
+  ]
+  ckpt = os.path.join(out_dir, 'checkpoints', sorted(ckpts)[-1])
+  export_dir = str(tmp_path / 'exported')
+  rc = cli.main([
+      'export', '--checkpoint', ckpt, '--output', export_dir,
+      '--batch_size', '8',
+  ])
+  assert rc == 0
+  serving, meta = export_lib.load_exported(export_dir)
+  assert meta['batch_size'] == 8
+  rows = jnp.zeros((8, params.total_rows, params.max_length, 1))
+  preds = serving(rows)
+  assert np.asarray(preds).shape == (8, params.max_length, 5)

@@ -187,3 +187,19 @@ def test_cli_train_uses_retry_wrapper(monkeypatch, tmp_path):
   ])
   assert rc == 0
   assert len(calls) == 2
+
+
+def test_pool_worker_never_raises_and_leaks_nothing(tmp_path):
+  """A failing featurization task must not raise (a raising starmap
+  task would discard sibling results, orphaning their shm segments)."""
+  import glob
+
+  from deepconsensus_tpu.inference import runner as runner_lib
+
+  before = set(glob.glob('/dev/shm/*'))
+  status, payload = runner_lib._pool_worker(
+      ('malformed', 'zmw', 'input'), runner_lib.InferenceOptions()
+  )
+  assert status == 'error'
+  assert 'Traceback' in payload
+  assert set(glob.glob('/dev/shm/*')) == before
