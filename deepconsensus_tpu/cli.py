@@ -73,6 +73,19 @@ def _add_train(sub):
                  help='This host\'s index (multi-host training).')
 
 
+def _add_port(sub):
+  p = sub.add_parser(
+      'port',
+      help='Port a reference TF checkpoint to a servable orbax '
+      'checkpoint (requires tensorflow).',
+  )
+  p.add_argument('--tf_checkpoint', required=True,
+                 help='TF checkpoint prefix (.../checkpoint-N).')
+  p.add_argument('--params', required=True,
+                 help='params.json path or directory containing it.')
+  p.add_argument('--out_dir', required=True)
+
+
 def _add_export(sub):
   p = sub.add_parser(
       'export',
@@ -135,6 +148,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_train(sub)
   _add_distill(sub)
   _add_export(sub)
+  _add_port(sub)
   _add_calibrate(sub)
   _add_yield_metrics(sub)
   _add_filter_reads(sub)
@@ -255,6 +269,15 @@ def _dispatch(args) -> int:
         mesh=mesh,
         warm_start=args.checkpoint,
     )
+    return 0
+
+  if args.command == 'port':
+    from deepconsensus_tpu.models import port_tf_checkpoint as port_lib
+
+    path = port_lib.port_to_orbax(
+        args.tf_checkpoint, args.params, args.out_dir
+    )
+    print(f'ported: {path}')
     return 0
 
   if args.command == 'export':

@@ -248,6 +248,9 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   # (forward scorer + custom-VJP backward) instead of the lax.scan DP.
   # Only applies when band_width is None (the training default).
   params.use_pallas_wavefront = False
+  # Rematerialize encoder blocks in the backward pass (jax.checkpoint):
+  # trades FLOPs for HBM headroom at large batch/long windows.
+  params.remat = False
   params.dp_axis = 'data'            # mesh axis names
   params.tp_axis = 'model'
   params.eval_every_n_steps = 3000

@@ -193,6 +193,17 @@ class EncoderStack(nn.Module):
   @nn.compact
   def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
     p = self.params
+
+    # Optional rematerialization: drop each residual block's
+    # activations and recompute them in the backward pass, trading
+    # FLOPs for HBM so long-window/large-batch runs fit
+    # (params.remat; jax.checkpoint under the hood).
+    def run_block(wrapper, x):
+      return wrapper(x, deterministic=deterministic)
+
+    if p.get('remat', False):
+      run_block = nn.remat(run_block)
+
     for n in range(p.num_hidden_layers):
       attn = BandedSelfAttention(
           hidden_size=p.hidden_size,
@@ -203,10 +214,14 @@ class EncoderStack(nn.Module):
           use_pallas=p.get('use_pallas_attention', False),
           name=f'self_attention_{n}',
       )
-      x = ResidualWrapper(
-          attn, rezero=p.rezero, dropout_rate=p.layer_postprocess_dropout,
-          name=f'attention_wrapper_{n}',
-      )(x, deterministic=deterministic)
+      x = run_block(
+          ResidualWrapper(
+              attn, rezero=p.rezero,
+              dropout_rate=p.layer_postprocess_dropout,
+              name=f'attention_wrapper_{n}',
+          ),
+          x,
+      )
       ffn = FeedForward(
           hidden_size=p.hidden_size,
           filter_size=p.filter_size,
@@ -214,10 +229,14 @@ class EncoderStack(nn.Module):
           dtype=self.dtype,
           name=f'ffn_{n}',
       )
-      x = ResidualWrapper(
-          ffn, rezero=p.rezero, dropout_rate=p.layer_postprocess_dropout,
-          name=f'ffn_wrapper_{n}',
-      )(x, deterministic=deterministic)
+      x = run_block(
+          ResidualWrapper(
+              ffn, rezero=p.rezero,
+              dropout_rate=p.layer_postprocess_dropout,
+              name=f'ffn_wrapper_{n}',
+          ),
+          x,
+      )
     return nn.LayerNorm(
         epsilon=1e-6, dtype=jnp.float32, name='output_normalization'
     )(x)

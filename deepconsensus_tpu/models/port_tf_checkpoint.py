@@ -142,8 +142,6 @@ def port_checkpoint(tf_checkpoint_prefix: str, flax_params):
   out = flax_params
   import jax
 
-  flat = dict(jax.tree_util.tree_flatten_with_path(flax_params)[0])
-
   def set_path(tree, path, value):
     node = tree
     for key in path[:-1]:
@@ -155,7 +153,95 @@ def port_checkpoint(tf_checkpoint_prefix: str, flax_params):
       )
     node[path[-1]] = value.astype(expected.dtype)
 
+  assigned = set()
   for tf_name, path in mapping.items():
     value = reader.get_tensor(tf_name)
     set_path(out, path, value)
+    assigned.add(path)
+
+  # Reverse coverage: every flax leaf must have been overwritten, or
+  # the result would silently mix ported weights with init values
+  # (e.g. a config enabling a module the TF checkpoint lacks).
+  all_paths = {
+      tuple(str(getattr(k, 'key', k)) for k in path)
+      for path, _ in jax.tree_util.tree_flatten_with_path(flax_params)[0]
+  }
+  missing = sorted(all_paths - assigned)
+  if missing:
+    raise ValueError(
+        'flax parameters not covered by the TF checkpoint (config/'
+        f'checkpoint mismatch): {missing}'
+    )
   return out
+
+
+def port_to_orbax(tf_checkpoint_prefix: str, params_json: str,
+                  out_dir: str) -> str:
+  """Ports a reference TF checkpoint to a servable orbax checkpoint.
+
+  Writes <out_dir>/checkpoints/checkpoint-0 + params.json so the result
+  drives `dctpu run --checkpoint <out_dir>/checkpoints/checkpoint-0`
+  (or warm-starts training) directly.
+  """
+  import os
+
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  import orbax.checkpoint as ocp
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = config_lib.read_params_from_json(params_json)
+  config_lib.finalize_params(params, is_training=False)
+  model = model_lib.get_model(params)
+  rows = jnp.zeros(
+      (1, params.total_rows, params.max_length, 1), jnp.float32
+  )
+  template = jax.tree.map(
+      np.asarray,
+      model.init(jax.random.PRNGKey(0), rows)['params'],
+  )
+  ported = port_checkpoint(tf_checkpoint_prefix, template)
+  path = os.path.join(
+      os.path.abspath(out_dir), 'checkpoints', 'checkpoint-0'
+  )
+  checkpointer = ocp.StandardCheckpointer()
+  checkpointer.save(path, {'params': ported}, force=True)
+  wait = getattr(checkpointer, 'wait_until_finished', None)
+  if wait is not None:
+    wait()
+  # Never clobber the source config: when --params already points at
+  # <out_dir>/params.json, the stripped/derived rewrite would destroy
+  # the original (losing e.g. its dataset keys).
+  target_json = os.path.join(os.path.abspath(out_dir), 'params.json')
+  source_json = (
+      params_json if params_json.endswith('.json')
+      else os.path.join(params_json, 'params.json')
+  )
+  if os.path.abspath(source_json) != target_json:
+    config_lib.save_params_as_json(out_dir, params)
+  return path
+
+
+def main(argv=None) -> int:
+  import argparse
+
+  parser = argparse.ArgumentParser(
+      description='Port a reference TF checkpoint to this framework.'
+  )
+  parser.add_argument('--tf_checkpoint', required=True,
+                      help='TF checkpoint prefix (…/checkpoint-N).')
+  parser.add_argument('--params', required=True,
+                      help='params.json path (ships beside reference '
+                      'checkpoints).')
+  parser.add_argument('--out_dir', required=True)
+  args = parser.parse_args(argv)
+  path = port_to_orbax(args.tf_checkpoint, args.params, args.out_dir)
+  print(f'ported: {path}')
+  return 0
+
+
+if __name__ == '__main__':
+  raise SystemExit(main())

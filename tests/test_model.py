@@ -160,3 +160,43 @@ def test_params_json_roundtrip(tmp_path):
   assert back.hidden_size == params.hidden_size
   assert back.max_passes == params.max_passes
   assert back.model_name == params.model_name
+
+
+def test_remat_encoder_matches_baseline():
+  """params.remat must not change values or gradients — only the
+  memory/recompute schedule."""
+  import jax
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 2
+    params.filter_size = 32
+  rng = np.random.default_rng(0)
+  rows = jnp.asarray(
+      rng.uniform(0, 4, size=(4, params.total_rows, params.max_length,
+                              1)).astype(np.float32))
+  model = model_lib.get_model(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  with params.unlocked():
+    params.remat = True
+  model_r = model_lib.get_model(params)
+
+  def loss(m):
+    return lambda v: jnp.sum(m.apply(v, rows) ** 2)
+
+  base_val, base_grad = jax.value_and_grad(loss(model))(variables)
+  remat_val, remat_grad = jax.value_and_grad(loss(model_r))(variables)
+  np.testing.assert_allclose(
+      float(remat_val), float(base_val), rtol=1e-6
+  )
+  flat_b = jax.tree_util.tree_leaves(base_grad)
+  flat_r = jax.tree_util.tree_leaves(remat_grad)
+  for gb, gr in zip(flat_b, flat_r):
+    np.testing.assert_allclose(
+        np.asarray(gr), np.asarray(gb), atol=1e-5, rtol=1e-4
+    )

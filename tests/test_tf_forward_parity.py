@@ -8,6 +8,7 @@ This is the test VERDICT r1 #5 asked for: it fails if any kernel
 layout/transpose in the port map is wrong — and, beyond the port, it
 proves the flax forward pass is numerically the reference model.
 """
+import os
 import sys
 import types
 
@@ -233,4 +234,68 @@ def test_port_rejects_shape_mismatch(reference_model_and_checkpoint):
       np.asarray, model.init(jax.random.PRNGKey(0), rows)['params']
   )
   with pytest.raises(ValueError, match='shape mismatch'):
+    port.port_checkpoint(prefix, flax_params)
+
+
+def test_port_to_orbax_cli_roundtrip(reference_model_and_checkpoint,
+                                     tmp_path):
+  """The port tool's CLI path: TF checkpoint -> orbax checkpoint that
+  loads through the standard inference loader with identical outputs."""
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import checkpoints as ckpt_lib
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.models import port_tf_checkpoint as port
+
+  _, rows, preds_tf, prefix = reference_model_and_checkpoint
+  out_dir = str(tmp_path / 'ported')
+  # params.json: reuse this framework's config (same architecture).
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+  config_lib.save_params_as_json(out_dir, params)
+
+  rc = port.main([
+      '--tf_checkpoint', prefix,
+      '--params', out_dir,
+      '--out_dir', out_dir,
+  ])
+  assert rc == 0
+  ported_ckpt = os.path.join(out_dir, 'checkpoints', 'checkpoint-0')
+  loaded = ckpt_lib.load_params(ported_ckpt)
+  model = model_lib.get_model(params)
+  preds = np.asarray(
+      model.apply({'params': loaded}, jnp.asarray(rows))
+  )
+  np.testing.assert_allclose(preds, preds_tf, atol=1e-4, rtol=1e-3)
+
+
+def test_port_rejects_uncovered_flax_params(
+    reference_model_and_checkpoint):
+  """A flax module the TF checkpoint lacks must fail loudly instead of
+  silently shipping init-valued weights."""
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.models import port_tf_checkpoint as port
+
+  _, _, _, prefix = reference_model_and_checkpoint
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  flax_params = jax.tree.map(
+      np.asarray, model.init(jax.random.PRNGKey(0), rows)['params']
+  )
+  flax_params['phantom_module'] = {
+      'kernel': np.zeros((3, 3), np.float32)
+  }
+  with pytest.raises(ValueError, match='not covered'):
     port.port_checkpoint(prefix, flax_params)
