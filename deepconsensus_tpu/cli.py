@@ -48,6 +48,9 @@ def _add_run(sub):
   p.add_argument('--dp', type=int, default=0,
                  help='Shard the window batch over this many devices '
                  '(0 = single device).')
+  p.add_argument('--tp', type=int, default=1,
+                 help='Tensor-parallel mesh size per data shard '
+                 '(attention heads / FFN filter shard).')
   p.add_argument('--cpus', type=int, default=0,
                  help='Featurization worker processes (0 or 1 = '
                  'in-process; tensors travel via shared memory).')
@@ -219,13 +222,14 @@ def _dispatch(args) -> int:
         ),
     )
     mesh = None
-    if args.dp:
+    if args.dp or args.tp > 1:
       import jax
 
       from deepconsensus_tpu.parallel import mesh as mesh_lib
 
+      dp = args.dp or 1
       mesh = mesh_lib.make_mesh(
-          dp=args.dp, tp=1, devices=jax.devices()[:args.dp]
+          dp=dp, tp=args.tp, devices=jax.devices()[:dp * args.tp]
       )
     counters = runner_lib.run_inference(
         subreads_to_ccs=args.subreads_to_ccs,

@@ -109,11 +109,21 @@ class ModelRunner:
             f'batch_size={options.batch_size} not divisible by the mesh '
             f'data axis ({dp} devices)'
         )
-      # Replicate the weights across the mesh once; otherwise every
-      # forward re-broadcasts host arrays to all devices.
-      self.variables = jax.device_put(
-          variables, mesh_lib.replicated(mesh)
+      # Place the weights on the mesh once; otherwise every forward
+      # re-broadcasts host arrays to all devices. param_shardings
+      # shards attention heads / FFN filters on the model axis under
+      # tp>1 and degenerates to replication at tp=1 (same rules as
+      # training); the non-params collections always replicate.
+      self.variables = dict(variables)
+      self.variables['params'] = jax.device_put(
+          variables['params'],
+          mesh_lib.param_shardings(mesh, variables['params']),
       )
+      extra = {k: v for k, v in variables.items() if k != 'params'}
+      if extra:
+        self.variables.update(
+            jax.device_put(extra, mesh_lib.replicated(mesh))
+        )
     model = model_lib.get_model(params)
 
     def forward(variables, rows):
@@ -133,7 +143,9 @@ class ModelRunner:
     batch_sh = mesh_lib.batch_sharding(mesh)
     return jax.jit(
         forward,
-        in_shardings=(mesh_lib.replicated(mesh), batch_sh),
+        # Variables keep the placement __init__ gave them (replicated,
+        # or model-axis sharded under tp>1).
+        in_shardings=(None, batch_sh),
         out_shardings=(batch_sh, batch_sh),
     )
 

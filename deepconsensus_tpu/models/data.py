@@ -260,3 +260,61 @@ class StreamingDataset:
       # Stop the producer when the consumer abandons the iterator
       # (GeneratorExit) so retries don't accumulate blocked threads.
       stop.set()
+
+
+def prefetch_iterator(iterator, depth: int = 2):
+  """Runs `iterator` in a background thread, keeping up to `depth`
+  batches ready, so host-side decode/shuffle/stacking overlaps device
+  compute (the reference gets this from tf.data prefetch;
+  data_providers.py uses AUTOTUNE). Exceptions re-raise at the
+  consumer; closing the generator stops the producer.
+  """
+  import queue
+  import threading
+
+  q: 'queue.Queue' = queue.Queue(maxsize=depth)
+  stop = threading.Event()
+  _END = object()
+
+  def producer():
+    try:
+      for item in iterator:
+        while not stop.is_set():
+          try:
+            q.put(('item', item), timeout=0.2)
+            break
+          except queue.Full:
+            continue
+        if stop.is_set():
+          return
+      q.put(('end', _END))
+    except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+      # Same retry-until-stopped discipline as item puts: dropping the
+      # sentinel on a momentarily-full queue would leave the consumer
+      # blocked on q.get() forever instead of seeing the error.
+      while not stop.is_set():
+        try:
+          q.put(('error', e), timeout=0.2)
+          return
+        except queue.Full:
+          continue
+
+  thread = threading.Thread(target=producer, daemon=True)
+  thread.start()
+  try:
+    while True:
+      kind, payload = q.get()
+      if kind == 'end':
+        return
+      if kind == 'error':
+        raise payload
+      yield payload
+  finally:
+    stop.set()
+    # Drain so a blocked producer can observe stop and exit.
+    while not q.empty():
+      try:
+        q.get_nowait()
+      except queue.Empty:
+        break
+    thread.join(timeout=10)

@@ -225,23 +225,10 @@ def port_to_orbax(tf_checkpoint_prefix: str, params_json: str,
   return path
 
 
-def main(argv=None) -> int:
-  import argparse
-
-  parser = argparse.ArgumentParser(
-      description='Port a reference TF checkpoint to this framework.'
-  )
-  parser.add_argument('--tf_checkpoint', required=True,
-                      help='TF checkpoint prefix (…/checkpoint-N).')
-  parser.add_argument('--params', required=True,
-                      help='params.json path (ships beside reference '
-                      'checkpoints).')
-  parser.add_argument('--out_dir', required=True)
-  args = parser.parse_args(argv)
-  path = port_to_orbax(args.tf_checkpoint, args.params, args.out_dir)
-  print(f'ported: {path}')
-  return 0
-
-
 if __name__ == '__main__':
-  raise SystemExit(main())
+  # Single source of truth for flags/dispatch: the dctpu CLI.
+  import sys
+
+  from deepconsensus_tpu import cli
+
+  raise SystemExit(cli.main(['port', *sys.argv[1:]]))

@@ -585,7 +585,11 @@ def run_training(
 
   final_metrics: Dict[str, float] = {}
   try:
-    for batch in train_batches():
+    # Background prefetch: host-side decode/shuffle/stacking for batch
+    # i+1 overlaps the device's step i (the async dispatch returns
+    # before compute finishes). Reference counterpart: tf.data
+    # prefetch(AUTOTUNE) in data_providers.py.
+    for batch in data_lib.prefetch_iterator(train_batches()):
       batch = trainer.globalize_batch(batch)
       with jax.profiler.StepTraceAnnotation('train', step_num=step):
         state, m = train_step(state, batch)

@@ -193,3 +193,42 @@ def test_mesh_batch_divisibility_guard():
   mesh = mesh_lib.make_mesh(dp=8, tp=1)
   with pytest.raises(ValueError, match='not divisible'):
     runner_lib.ModelRunner(params, {}, options, mesh=mesh)
+
+
+def test_tp_mesh_inference_matches_single_device(testdata_dir, tmp_path):
+  """dp x tp inference: weights shard on the model axis, outputs stay
+  byte-identical to single-device."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 64
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  variables = model.init(jax.random.PRNGKey(0), rows)
+
+  mesh = mesh_lib.make_mesh(dp=4, tp=2)
+  shardings = mesh_lib.param_shardings(mesh, variables['params'])
+  assert mesh_lib.count_model_sharded(shardings) > 0
+
+  outputs = {}
+  for name, m in (('single', None), ('tp', mesh)):
+    options = runner_lib.InferenceOptions(
+        batch_size=32, batch_zmws=4, limit=2, min_quality=0
+    )
+    runner = runner_lib.ModelRunner(params, variables, options, mesh=m)
+    out = str(tmp_path / f'{name}.fastq')
+    runner_lib.run_inference(
+        subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+        ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+        checkpoint=None,
+        output=out,
+        options=options,
+        runner=runner,
+    )
+    with open(out, 'rb') as f:
+      outputs[name] = f.read()
+  assert outputs['single'] and outputs['single'] == outputs['tp']

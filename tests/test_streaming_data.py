@@ -23,3 +23,47 @@ def test_streaming_dataset(testdata_dir):
   # Stream repeats past one epoch without exhausting (1239 examples).
   more = list(itertools.islice(iter(ds), 100))
   assert len(more) == 100
+
+
+def test_prefetch_iterator_matches_plain():
+  from deepconsensus_tpu.models import data as data_lib
+
+  items = [{'a': np.full((2, 2), i)} for i in range(7)]
+  got = list(data_lib.prefetch_iterator(iter(items), depth=2))
+  assert len(got) == 7
+  for want, g in zip(items, got):
+    np.testing.assert_array_equal(g['a'], want['a'])
+
+
+def test_prefetch_iterator_propagates_errors():
+  from deepconsensus_tpu.models import data as data_lib
+
+  def bad():
+    yield {'a': np.zeros(1)}
+    raise RuntimeError('boom in producer')
+
+  it = data_lib.prefetch_iterator(bad())
+  next(it)
+  import pytest as _pytest
+  with _pytest.raises(RuntimeError, match='boom in producer'):
+    next(it)
+
+
+def test_prefetch_iterator_early_close_stops_producer():
+  import threading
+
+  from deepconsensus_tpu.models import data as data_lib
+
+  produced = []
+
+  def source():
+    for i in range(10_000):
+      produced.append(i)
+      yield {'a': np.zeros(1)}
+
+  it = data_lib.prefetch_iterator(source(), depth=2)
+  next(it)
+  it.close()
+  n_after_close = len(produced)
+  assert n_after_close < 50  # producer stopped, didn't drain 10k
+  assert threading.active_count() < 20

@@ -244,10 +244,10 @@ def test_port_to_orbax_cli_roundtrip(reference_model_and_checkpoint,
   import jax
   import jax.numpy as jnp
 
+  from deepconsensus_tpu import cli
   from deepconsensus_tpu.models import checkpoints as ckpt_lib
   from deepconsensus_tpu.models import config as config_lib
   from deepconsensus_tpu.models import model as model_lib
-  from deepconsensus_tpu.models import port_tf_checkpoint as port
 
   _, rows, preds_tf, prefix = reference_model_and_checkpoint
   out_dir = str(tmp_path / 'ported')
@@ -258,7 +258,8 @@ def test_port_to_orbax_cli_roundtrip(reference_model_and_checkpoint,
     params.dtype = 'float32'
   config_lib.save_params_as_json(out_dir, params)
 
-  rc = port.main([
+  rc = cli.main([
+      'port',
       '--tf_checkpoint', prefix,
       '--params', out_dir,
       '--out_dir', out_dir,
