@@ -76,6 +76,20 @@ def _add_train(sub):
                  help='This host\'s index (multi-host training).')
 
 
+def _add_evaluate(sub):
+  p = sub.add_parser(
+      'evaluate',
+      help='Offline eval over labeled TFRecords -> inference.csv '
+      '(counterpart of the reference model_inference binary).',
+  )
+  p.add_argument('--checkpoint', required=True)
+  p.add_argument('--eval_path', nargs='+', required=True)
+  p.add_argument('--out_dir', required=True)
+  p.add_argument('--limit', type=int, default=-1,
+                 help='Max eval examples (-1 = all).')
+  p.add_argument('--batch_size', type=int)
+
+
 def _add_port(sub):
   p = sub.add_parser(
       'port',
@@ -152,6 +166,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_distill(sub)
   _add_export(sub)
   _add_port(sub)
+  _add_evaluate(sub)
   _add_calibrate(sub)
   _add_yield_metrics(sub)
   _add_filter_reads(sub)
@@ -273,6 +288,25 @@ def _dispatch(args) -> int:
         mesh=mesh,
         warm_start=args.checkpoint,
     )
+    return 0
+
+  if args.command == 'evaluate':
+    from deepconsensus_tpu.models import config as config_lib
+    from deepconsensus_tpu.models import evaluate as evaluate_lib
+
+    params = config_lib.read_params_from_json(args.checkpoint)
+    config_lib.finalize_params(params, is_training=False)
+    with params.unlocked():
+      if args.batch_size:
+        params.batch_size = args.batch_size
+    metrics = evaluate_lib.run_evaluation(
+        params=params,
+        checkpoint_path=args.checkpoint,
+        eval_patterns=args.eval_path,
+        out_dir=args.out_dir,
+        limit=args.limit,
+    )
+    print(' '.join(f'{k}={v:.5f}' for k, v in sorted(metrics.items())))
     return 0
 
   if args.command == 'port':

@@ -114,15 +114,20 @@ class ModelRunner:
       # shards attention heads / FFN filters on the model axis under
       # tp>1 and degenerates to replication at tp=1 (same rules as
       # training); the non-params collections always replicate.
-      self.variables = dict(variables)
-      self.variables['params'] = jax.device_put(
-          variables['params'],
-          mesh_lib.param_shardings(mesh, variables['params']),
-      )
-      extra = {k: v for k, v in variables.items() if k != 'params'}
-      if extra:
-        self.variables.update(
-            jax.device_put(extra, mesh_lib.replicated(mesh))
+      self.variables = dict(variables) if variables else variables
+      if variables and 'params' in variables:
+        self.variables['params'] = jax.device_put(
+            variables['params'],
+            mesh_lib.param_shardings(mesh, variables['params']),
+        )
+        extra = {k: v for k, v in variables.items() if k != 'params'}
+        if extra:
+          self.variables.update(
+              jax.device_put(extra, mesh_lib.replicated(mesh))
+          )
+      elif variables:
+        self.variables = jax.device_put(
+            variables, mesh_lib.replicated(mesh)
         )
     model = model_lib.get_model(params)
 

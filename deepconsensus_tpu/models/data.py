@@ -287,7 +287,12 @@ def prefetch_iterator(iterator, depth: int = 2):
             continue
         if stop.is_set():
           return
-      q.put(('end', _END))
+      while not stop.is_set():
+        try:
+          q.put(('end', _END), timeout=0.2)
+          return
+        except queue.Full:
+          continue
     except BaseException as e:  # noqa: BLE001 - surfaced to consumer
       # Same retry-until-stopped discipline as item puts: dropping the
       # sentinel on a momentarily-full queue would leave the consumer

@@ -81,6 +81,12 @@ def run_evaluation(
     for k, v in out.items():
       sums[k] = sums.get(k, 0.0) + v
     batches += 1
+  if not batches:
+    raise ValueError(
+        f'no complete eval batches: {eval_patterns!r} yielded fewer '
+        f'than batch_size={params.batch_size} examples '
+        '(limit counts examples, not batches)'
+    )
   metrics = {
       'loss': sums['loss'] / batches,
       'per_example_accuracy': (

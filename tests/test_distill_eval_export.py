@@ -157,3 +157,36 @@ def test_cli_export_subcommand(tmp_path, testdata_dir):
   rows = jnp.zeros((8, params.total_rows, params.max_length, 1))
   preds = serving(rows)
   assert np.asarray(preds).shape == (8, params.max_length, 5)
+
+
+def test_cli_evaluate_subcommand(tmp_path, testdata_dir):
+  from deepconsensus_tpu import cli
+  from deepconsensus_tpu.models import train as train_lib
+
+  params = _params(layers=1)
+  out_dir = str(tmp_path / 'train')
+  patterns = [str(testdata_dir / 'human_1m/tf_examples/eval/*')]
+  with params.unlocked():
+    params.batch_size = 8
+  train_lib.run_training(
+      params=params, out_dir=out_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=1, eval_every=10**9,
+  )
+  ckpts = sorted(
+      n for n in os.listdir(os.path.join(out_dir, 'checkpoints'))
+      if n.startswith('checkpoint-') and not n.endswith('-tmp')
+  )
+  eval_dir = str(tmp_path / 'eval_out')
+  rc = cli.main([
+      'evaluate',
+      '--checkpoint', os.path.join(out_dir, 'checkpoints', ckpts[-1]),
+      '--eval_path', patterns[0],
+      '--out_dir', eval_dir, '--limit', '16',
+  ])
+  assert rc == 0
+  csv_path = os.path.join(eval_dir, 'inference.csv')
+  assert os.path.exists(csv_path)
+  with open(csv_path) as f:
+    header, row = f.read().strip().splitlines()
+  assert 'loss' in header and row
