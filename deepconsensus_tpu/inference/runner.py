@@ -114,21 +114,15 @@ class ModelRunner:
       # shards attention heads / FFN filters on the model axis under
       # tp>1 and degenerates to replication at tp=1 (same rules as
       # training); the non-params collections always replicate.
-      self.variables = dict(variables) if variables else variables
-      if variables and 'params' in variables:
-        self.variables['params'] = jax.device_put(
-            variables['params'],
-            mesh_lib.param_shardings(mesh, variables['params']),
-        )
-        extra = {k: v for k, v in variables.items() if k != 'params'}
-        if extra:
-          self.variables.update(
-              jax.device_put(extra, mesh_lib.replicated(mesh))
-          )
-      elif variables:
-        self.variables = jax.device_put(
-            variables, mesh_lib.replicated(mesh)
-        )
+      if variables:
+        self.variables = {
+            key: jax.device_put(
+                value,
+                mesh_lib.param_shardings(mesh, value)
+                if key == 'params' else mesh_lib.replicated(mesh),
+            )
+            for key, value in variables.items()
+        }
     model = model_lib.get_model(params)
 
     def forward(variables, rows):
@@ -701,7 +695,17 @@ def run_inference(
     def producer():
       try:
         def flush(zmw_batch) -> bool:
-          if not zmw_batch or skip_featurize:
+          if not zmw_batch:
+            return True
+          if skip_featurize:
+            # dc_input stage: measure BAM decode/grouping only, so the
+            # runtime CSV still carries one row per batch.
+            timing_rows.append(
+                dict(stage='dc_input',
+                     runtime=time.time() - flush.t_start,
+                     n_zmws=len(zmw_batch), n_examples=0,
+                     n_subreads=sum(len(z[0]) - 1 for z in zmw_batch)))
+            flush.t_start = time.time()
             return True
           feat = featurize_batch(zmw_batch)
           ok = put(('batch', feat))
@@ -711,6 +715,7 @@ def run_inference(
             release_shm(feat)
           return ok
 
+        flush.t_start = time.time()
         zmw_batch = []
         for zmw_input in feeder():
           zmw_batch.append(zmw_input)
@@ -761,6 +766,6 @@ def run_inference(
   counters.update(dataclasses.asdict(outcome))
   with open(output + '.inference.json', 'w') as f:
     json.dump(counters, f, indent=2, sort_keys=True)
-  if not outcome.success:
+  if not outcome.success and options.end_after_stage == 'full':
     log.warning('No reads passed filters; outcome=%s', outcome)
   return counters

@@ -200,3 +200,15 @@ def test_remat_encoder_matches_baseline():
     np.testing.assert_allclose(
         np.asarray(gr), np.asarray(gb), atol=1e-5, rtol=1e-4
     )
+
+
+def test_unknown_model_name_raises():
+  """(reference model_utils_test: test_invalid_model_name_throws_error)"""
+  import ml_collections
+  import pytest as _pytest
+
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = ml_collections.ConfigDict({'model_name': 'nonexistent_net'})
+  with _pytest.raises(ValueError, match='Unknown model name'):
+    model_lib.get_model(params)

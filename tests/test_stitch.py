@@ -75,3 +75,22 @@ def test_calibration_lib():
   np.testing.assert_allclose(
       lib.calibrate_quality_scores(scores, cv0), scores * 2 + 1
   )
+
+
+def test_stitch_fill_n_pads_missing_window():
+  """fill_n=True replaces a knocked-out window with Ns at EMPTY_QUAL
+  (reference stitch_utils_test: test_get_partial_sequences)."""
+  from deepconsensus_tpu import constants
+  from deepconsensus_tpu.utils import phred
+
+  outs = [make_output(0, 'ACGT'), make_output(8, 'TTGG')]  # window 4-8 gone
+  seq, qual = stitch.get_full_sequence(outs, max_length=4, fill_n=True)
+  assert seq == 'ACGT' + 'NNNN' + 'TTGG'
+  empty = phred.quality_scores_to_string([constants.EMPTY_QUAL] * 4)
+  assert qual == 'IIII' + empty + 'IIII'
+
+
+def test_stitch_fill_n_false_fails():
+  outs = [make_output(0, 'ACGT'), make_output(8, 'TTGG')]
+  seq, qual = stitch.get_full_sequence(outs, max_length=4, fill_n=False)
+  assert seq is None
