@@ -58,6 +58,11 @@ def _add_run(sub):
                  choices=['dc_input', 'tf_examples', 'run_model', 'full'],
                  help='Stop the pipeline early for debugging/timing '
                  '(reference DebugStage).')
+  p.add_argument('--shard', default=None, metavar='I/N',
+                 type=_parse_shard,
+                 help='Process only ZMWs with zm %% N == I, e.g. 3/500 '
+                 '— fleet scaling over one shared BAM without '
+                 'splitting it.')
 
 
 def _add_train(sub):
@@ -158,6 +163,20 @@ def _add_filter_reads(sub):
   p.add_argument('--quality', type=int, required=True)
 
 
+def _parse_shard(value):
+  """argparse type: 'I/N' -> (i, n) with 0 <= i < n."""
+  try:
+    i_str, n_str = value.split('/')
+    i, n = int(i_str), int(n_str)
+  except ValueError:
+    raise argparse.ArgumentTypeError(
+        f'expected I/N (e.g. 3/500), got {value!r}'
+    )
+  if not 0 <= i < n:
+    raise argparse.ArgumentTypeError(f'need 0 <= I < N, got {value!r}')
+  return (i, n)
+
+
 def build_parser() -> argparse.ArgumentParser:
   parser = argparse.ArgumentParser(
       prog='dctpu',
@@ -234,6 +253,7 @@ def _dispatch(args) -> int:
         limit=args.limit,
         cpus=args.cpus,
         end_after_stage=args.end_after_stage,
+        shard=args.shard,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal
         ),

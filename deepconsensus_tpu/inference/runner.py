@@ -62,6 +62,10 @@ class InferenceOptions:
   use_ccs_smart_windows: bool = False
   max_base_quality: int = 93
   limit: int = 0
+  # (i, n): keep only ZMWs with zm % n == i — single-flag fleet scaling
+  # over one shared BAM (the reference's shard-the-BAM pattern without
+  # the external splitting step).
+  shard: Optional[Tuple[int, int]] = None
   # >0: featurization worker pool. Measured caveat: shipping featurized
   # windows between processes is IPC-bound (~6 MB/ZMW), so on fast
   # hosts the serial path (~20k windows/s, matching one chip's forward
@@ -485,9 +489,13 @@ def run_inference(
       ins_trim=options.ins_trim,
       use_ccs_smart_windows=options.use_ccs_smart_windows,
       limit=options.limit,
+      shard=options.shard,
   )
   pool = None
-  if options.cpus and options.cpus > 1:
+  if (options.cpus and options.cpus > 1
+      and options.end_after_stage != 'dc_input'):
+    # dc_input runs never featurize; forking idle workers would only
+    # pollute the stage timing the flag exists to measure.
     import multiprocessing
 
     pool = multiprocessing.Pool(options.cpus)

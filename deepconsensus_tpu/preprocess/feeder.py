@@ -122,8 +122,14 @@ def create_proc_feeder(
     truth_split: Optional[str] = None,
     limit: int = 0,
     ccs_fasta: Optional[str] = None,
+    shard: Optional[Tuple[int, int]] = None,
 ):
-  """Returns (generator_fn, counter) yielding per-ZMW work items."""
+  """Returns (generator_fn, counter) yielding per-ZMW work items.
+
+  shard=(i, n) keeps only ZMWs with zm % n == i — built-in fleet
+  scaling over one shared BAM, replacing the reference's external
+  500-way BAM-splitting step (docs/quick_start.md:82-99 upstream).
+  """
   main_counter: Counter = Counter()
   grouper = bam.SubreadGrouper(subreads_to_ccs)
   if ccs_bam:
@@ -142,11 +148,25 @@ def create_proc_feeder(
   def proc_feeder() -> Iterator[ZmwInput]:
     for read_set in grouper:
       main_counter['n_zmw_processed'] += 1
+      ccs_seqname = read_set[0].reference_name
+      if shard is not None:
+        # The lockstep ccs_iter scan below skips over filtered ZMWs'
+        # records on its own (both BAMs share the same order), so a
+        # sharded-out ZMW costs no expansion work at all.
+        try:
+          zm = int(ccs_seqname.split('/')[1])
+        except (IndexError, ValueError):
+          raise ValueError(
+              f'shard={shard} requires PacBio movie/zm/ccs read names '
+              f'to extract the zm hole number; got {ccs_seqname!r}'
+          )
+        if zm % shard[1] != shard[0]:
+          main_counter['n_zmw_sharded_out'] += 1
+          continue
       subreads = [
           expand_aligned_record(rec, ins_trim=ins_trim, counter=main_counter)
           for rec in read_set
       ]
-      ccs_seqname = read_set[0].reference_name
       # The ccs bam is ordered like the subread bam; skip CCS reads with
       # no mapped subreads (reference: pre_lib.py:1320-1326).
       for ccs_record in ccs_iter:

@@ -14,18 +14,25 @@ from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import model as model_lib
 
 
-@pytest.fixture(scope='module')
-def small_runner():
+def tiny_model():
+  """Shared small model recipe for the e2e inference tests."""
   params = config_lib.get_config('transformer_learn_values+test')
   config_lib.finalize_params(params, is_training=False)
   with params.unlocked():
     params.dtype = 'float32'
     params.num_hidden_layers = 1
     params.filter_size = 64
-  options = runner_lib.InferenceOptions(batch_size=32, batch_zmws=4, limit=3)
   model = model_lib.get_model(params)
   rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
   variables = model.init(jax.random.PRNGKey(0), rows)
+  return params, variables
+
+
+
+@pytest.fixture(scope='module')
+def small_runner():
+  params, variables = tiny_model()
+  options = runner_lib.InferenceOptions(batch_size=32, batch_zmws=4, limit=3)
   return runner_lib.ModelRunner(params, variables, options), options
 
 
@@ -149,15 +156,7 @@ def test_mesh_inference_matches_single_device(testdata_dir, tmp_path):
   (VERDICT r1 #4: window batch sharded over the mesh data axis)."""
   from deepconsensus_tpu.parallel import mesh as mesh_lib
 
-  params = config_lib.get_config('transformer_learn_values+test')
-  config_lib.finalize_params(params, is_training=False)
-  with params.unlocked():
-    params.dtype = 'float32'
-    params.num_hidden_layers = 1
-    params.filter_size = 64
-  model = model_lib.get_model(params)
-  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
-  variables = model.init(jax.random.PRNGKey(0), rows)
+  params, variables = tiny_model()
 
   outputs = {}
   for name, mesh in (
@@ -200,15 +199,7 @@ def test_tp_mesh_inference_matches_single_device(testdata_dir, tmp_path):
   byte-identical to single-device."""
   from deepconsensus_tpu.parallel import mesh as mesh_lib
 
-  params = config_lib.get_config('transformer_learn_values+test')
-  config_lib.finalize_params(params, is_training=False)
-  with params.unlocked():
-    params.dtype = 'float32'
-    params.num_hidden_layers = 1
-    params.filter_size = 64
-  model = model_lib.get_model(params)
-  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
-  variables = model.init(jax.random.PRNGKey(0), rows)
+  params, variables = tiny_model()
 
   mesh = mesh_lib.make_mesh(dp=4, tp=2)
   shardings = mesh_lib.param_shardings(mesh, variables['params'])
@@ -232,3 +223,37 @@ def test_tp_mesh_inference_matches_single_device(testdata_dir, tmp_path):
     with open(out, 'rb') as f:
       outputs[name] = f.read()
   assert outputs['single'] and outputs['single'] == outputs['tp']
+
+
+def test_sharded_inference_partitions_zmws(testdata_dir, tmp_path):
+  """shard=(i,n) runs partition the ZMW set exactly: the union of all
+  shards' FASTQ reads equals the unsharded run's reads."""
+  params, variables = tiny_model()
+
+  def reads_of(path):
+    return {name: seq for name, seq, _ in fastx.read_fastq(path)}
+
+  def run(name, shard):
+    options = runner_lib.InferenceOptions(
+        batch_size=32, batch_zmws=4, min_quality=0,
+        skip_windows_above=1, shard=shard,
+    )
+    runner = runner_lib.ModelRunner(params, variables, options)
+    out = str(tmp_path / f'{name}.fastq')
+    counters = runner_lib.run_inference(
+        subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
+        ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
+        checkpoint=None,
+        output=out,
+        options=options,
+        runner=runner,
+    )
+    return reads_of(out), counters
+
+  full, _ = run('full', None)
+  shard0, c0 = run('s0', (0, 2))
+  shard1, c1 = run('s1', (1, 2))
+  assert c0['n_zmw_sharded_out'] > 0 and c1['n_zmw_sharded_out'] > 0
+  assert not set(shard0) & set(shard1)
+  merged = {**shard0, **shard1}
+  assert merged == full
