@@ -26,6 +26,10 @@ def _add_preprocess(sub):
   p.add_argument('--truth_split')
   p.add_argument('--limit', type=int, default=0)
   p.add_argument('--cpus', type=int, default=0)
+  p.add_argument('--shard', default=None, metavar='I/N',
+                 type=_parse_shard,
+                 help='Process only ZMWs with zm %% N == I (fleet '
+                 'scaling; shard the output paths too).')
 
 
 def _add_run(sub):
@@ -229,6 +233,7 @@ def _dispatch(args) -> int:
         truth_split=args.truth_split,
         limit=args.limit,
         cpus=args.cpus,
+        shard=args.shard,
     )
     return 0
 

@@ -42,6 +42,7 @@ def run_preprocess(
     truth_split: Optional[str] = None,
     limit: int = 0,
     cpus: int = 0,
+    shard: Optional[tuple] = None,
 ) -> Dict[str, int]:
   """Writes examples to `output` ('@split' expands per split).
 
@@ -65,6 +66,7 @@ def run_preprocess(
       truth_to_ccs=truth_to_ccs,
       truth_split=truth_split,
       limit=limit,
+      shard=shard,
   )
 
   writers = {}

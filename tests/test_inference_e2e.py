@@ -257,3 +257,32 @@ def test_sharded_inference_partitions_zmws(testdata_dir, tmp_path):
   assert not set(shard0) & set(shard1)
   merged = {**shard0, **shard1}
   assert merged == full
+
+
+def test_preprocess_shard_partitions_examples(testdata_dir, tmp_path):
+  """Preprocess shards partition the example set exactly."""
+  from deepconsensus_tpu.io import tfrecord
+  from deepconsensus_tpu.preprocess.driver import run_preprocess
+
+  td = str(testdata_dir / 'human_1m')
+
+  def run(name, shard):
+    out = str(tmp_path / name / 'inference.tfrecord.gz')
+    summary = run_preprocess(
+        subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+        ccs_bam=f'{td}/ccs.bam',
+        output=out,
+        ins_trim=5,
+        shard=shard,
+    )
+    records = set()
+    for raw in tfrecord.read_tfrecords(out):
+      records.add(raw)
+    return records, summary
+
+  full, _ = run('full', None)
+  s0, sum0 = run('s0', (0, 2))
+  s1, sum1 = run('s1', (1, 2))
+  assert sum0['n_zmw_sharded_out'] > 0 and sum1['n_zmw_sharded_out'] > 0
+  assert not s0 & s1
+  assert (s0 | s1) == full
