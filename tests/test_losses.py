@@ -179,3 +179,50 @@ def test_distillation_loss_zero_for_identical():
   other = logits + 1.0  # softmax-invariant shift -> still zero
   assert float(losses.distillation_loss(logits, other)) == pytest.approx(
       0.0, abs=1e-6)
+
+
+def test_xentropy_subs_cost_pointwise():
+  """Pairwise substitution costs equal naive per-(i,j) cross-entropy
+  (reference: losses_and_metrics_test XentropySubsCostFn, incl. the
+  unequal-length case)."""
+  rng = np.random.default_rng(0)
+  b, m, n, vocab = 2, 4, 6, 5
+  y_true = jnp.asarray(rng.integers(1, vocab, size=(b, m)), jnp.int32)
+  y_pred = rng.uniform(size=(b, n, vocab)).astype(np.float32)
+  y_pred /= y_pred.sum(-1, keepdims=True)
+  got = np.asarray(losses.xentropy_subs_cost(y_true, jnp.asarray(y_pred)))
+  assert got.shape == (b, m, n)
+  for bi in range(b):
+    for i in range(m):
+      for j in range(n):
+        want = -np.log(y_pred[bi, j, int(y_true[bi, i])])
+        np.testing.assert_allclose(got[bi, i, j], want, rtol=1e-5)
+
+
+def test_xentropy_ins_cost_pointwise():
+  rng = np.random.default_rng(1)
+  b, n, vocab = 3, 5, 5
+  y_pred = rng.uniform(size=(b, n, vocab)).astype(np.float32)
+  y_pred /= y_pred.sum(-1, keepdims=True)
+  got = np.asarray(losses.xentropy_ins_cost(jnp.asarray(y_pred)))
+  want = -np.log(y_pred[..., constants.GAP_INT])
+  np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize(
+    'threshold,ids_dc,ids_ccs,exp_over_ccs',
+    [
+        # (reference losses_and_metrics_test YieldOverCCSMetricTest)
+        (0.99, [1.0, 1.0], [1.0, 1.0], [1.0, 1.0]),
+        (0.99, [0.9, 1.0], [1.0, 1.0], [0.0, 0.5]),
+        (0.99, [1.0, 1.0], [0.9, 1.0], [0.0, 2.0]),
+        (0.99, [1.0, 1.0], [1.0, 0.9], [1.0, 2.0]),
+        (0.9, [0.9, 1.0], [1.0, 1.0], [1.0, 1.0]),
+    ],
+)
+def test_yield_over_ccs_multiple_updates(threshold, ids_dc, ids_ccs,
+                                         exp_over_ccs):
+  y = metrics.YieldOverCCS(quality_threshold=threshold)
+  for dc, ccs, want in zip(ids_dc, ids_ccs, exp_over_ccs):
+    y.update(ccs, dc)
+    assert y.result() == pytest.approx(want)
