@@ -11,6 +11,7 @@ from typing import Dict, Iterable, List, Tuple
 
 import numpy as np
 
+from deepconsensus_tpu import constants
 from deepconsensus_tpu.utils import phred
 
 
@@ -74,3 +75,46 @@ def summarize_errors(
   for truth, pred in pairs:
     total.update(error_kmers(truth, pred, k))
   return total.most_common(top)
+
+
+def edit_distance(s1: str, s2: str) -> int:
+  """Levenshtein distance between two sequences, gaps stripped first
+  (reference: model_inference_transforms.py:35-69). Vectorized over the
+  DP rows with numpy instead of the reference's per-cell Python loop.
+  """
+  s1 = s1.replace(constants.GAP, '')
+  s2 = s2.replace(constants.GAP, '')
+  if len(s1) > len(s2):
+    s1, s2 = s2, s1
+  if not s1:
+    return len(s2)
+  a = np.frombuffer(s1.encode('ascii'), dtype=np.uint8)
+  b = np.frombuffer(s2.encode('ascii'), dtype=np.uint8)
+  prev = np.arange(a.size + 1, dtype=np.int64)
+  idx = np.arange(1, a.size + 1)
+  for i, c in enumerate(b):
+    subst = prev[:-1] + (a != c)
+    delete = prev[1:] + 1
+    cur = np.minimum(subst, delete)
+    # Insertion carries a left-to-right dependency; numpy's running
+    # minimum over (cur - index) linearizes it.
+    cur = np.minimum.accumulate(
+        np.minimum(cur, np.concatenate(([i + 1], cur[:-1] + 1))) - idx
+    ) + idx
+    prev = np.concatenate(([i + 1], cur))
+  return int(prev[-1])
+
+
+def homopolymer_content(seq: str) -> float:
+  """Fraction of the sequence inside homopolymer runs of length >= 3
+  (reference: model_inference_transforms.py:72-79)."""
+  seq = seq.replace(constants.GAP, '')
+  if not seq:
+    return 0.0
+  arr = np.frombuffer(seq.encode('ascii'), dtype=np.uint8)
+  boundaries = np.flatnonzero(np.diff(arr) != 0)
+  run_lengths = np.diff(
+      np.concatenate(([0], boundaries + 1, [arr.size]))
+  )
+  hp = int(run_lengths[run_lengths >= 3].sum())
+  return round(hp / arr.size, 2)

@@ -96,3 +96,44 @@ def test_get_prediction_shapes():
   assert len(out['sequence']) == 100
   assert out['quality_scores'].shape == (100,)
   assert out['probabilities'].shape == (100, 5)
+
+
+def test_edit_distance_matches_naive():
+  """Vectorized Levenshtein vs a naive DP, incl. the reference doc
+  examples and gap stripping (model_inference_transforms.py:35-69)."""
+  import numpy as np
+
+  from deepconsensus_tpu.utils import analysis
+
+  def naive(s1, s2):
+    s1 = s1.replace(' ', '')
+    s2 = s2.replace(' ', '')
+    dp = list(range(len(s2) + 1))
+    for i, c1 in enumerate(s1):
+      ndp = [i + 1]
+      for j, c2 in enumerate(s2):
+        ndp.append(min(dp[j] + (c1 != c2), dp[j + 1] + 1, ndp[-1] + 1))
+      dp = ndp
+    return dp[-1]
+
+  assert analysis.edit_distance('CAT', 'BAT') == 1
+  assert analysis.edit_distance('CAT', 'BATS') == 2
+  assert analysis.edit_distance('C AT', 'BA TS') == 2  # gaps stripped
+  assert analysis.edit_distance('', 'ACGT') == 4
+
+  rng = np.random.default_rng(0)
+  bases = 'ACGT '
+  for _ in range(50):
+    s1 = ''.join(rng.choice(list(bases), size=rng.integers(0, 12)))
+    s2 = ''.join(rng.choice(list(bases), size=rng.integers(0, 12)))
+    assert analysis.edit_distance(s1, s2) == naive(s1, s2), (s1, s2)
+
+
+def test_homopolymer_content():
+  from deepconsensus_tpu.utils import analysis
+
+  assert analysis.homopolymer_content('') == 0.0
+  assert analysis.homopolymer_content('ACGT') == 0.0
+  assert analysis.homopolymer_content('AAAT') == 0.75
+  assert analysis.homopolymer_content('AAATTT') == 1.0
+  assert analysis.homopolymer_content('AA TTT') == 0.6  # gaps stripped
