@@ -10,6 +10,29 @@ import sys
 from typing import List, Optional
 
 
+def _coerce_override(raw: str, current):
+  """Parses a --set value against the config entry's current type."""
+  if raw.lower() in ('none', 'null'):
+    return None
+  if isinstance(current, bool):
+    if raw.lower() in ('true', '1', 'yes'):
+      return True
+    if raw.lower() in ('false', '0', 'no'):
+      return False
+    raise ValueError(f'expected a boolean, got {raw!r}')
+  for cast in (int, float):
+    if isinstance(current, cast):
+      return cast(raw)
+  if current is None:
+    # Untyped (e.g. band_width defaults to None): best-effort numeric.
+    for cast in (int, float):
+      try:
+        return cast(raw)
+      except ValueError:
+        continue
+  return raw
+
+
 def _add_preprocess(sub):
   p = sub.add_parser('preprocess', help='Generate examples from BAMs.')
   p.add_argument('--subreads_to_ccs', required=True)
@@ -78,6 +101,10 @@ def _add_train(sub):
   p.add_argument('--eval_path', nargs='*')
   p.add_argument('--num_epochs', type=int)
   p.add_argument('--batch_size', type=int)
+  p.add_argument('--set', action='append', default=[], metavar='KEY=VALUE',
+                 dest='overrides',
+                 help='Config override, repeatable (e.g. '
+                 '--set use_pallas_wavefront=true --set loss_reg=0.5).')
   p.add_argument('--checkpoint', help='Warm-start checkpoint.')
   p.add_argument('--tp', type=int, default=1,
                  help='Tensor-parallel mesh size.')
@@ -296,6 +323,14 @@ def _dispatch(args) -> int:
     from deepconsensus_tpu.parallel import mesh as mesh_lib
 
     params = config_lib.get_config(args.config)
+    # Overrides apply before finalize_params so derived values
+    # (total_rows, hidden_size) see them.
+    with params.unlocked():
+      for item in args.overrides:
+        key, eq, raw = item.partition('=')
+        if not eq or not hasattr(params, key):
+          raise ValueError(f'unknown config override {item!r}')
+        setattr(params, key, _coerce_override(raw, getattr(params, key)))
     config_lib.finalize_params(params)
     with params.unlocked():
       if args.batch_size:

@@ -209,9 +209,10 @@ def _bwd_call(q, k, v, mask, do, attn_win_size, keep_prob, interpret,
   dob = _blocks(do, n, l, d)
   has_mask = mask is not None
   if has_mask:
-    maskb = mask.reshape(n, l, l)
+    # f32 cast happens XLA-side: Mosaic has no uint8->f32 lowering.
+    maskb = mask.reshape(n, l, l).astype(jnp.float32)
   else:
-    maskb = jnp.zeros((n, 1, 1), jnp.uint8)  # unread placeholder
+    maskb = jnp.zeros((n, 1, 1), jnp.float32)  # unread placeholder
   spec = pl.BlockSpec((group, l, d), lambda i: (i, 0, 0),
                       memory_space=pltpu.VMEM)
   mask_spec = pl.BlockSpec(
@@ -271,7 +272,8 @@ def banded_attention_dropout_vjp(q, k, v, mask, attn_win_size,
   while n % group:
     group -= 1
   qb, kb, vb = (_blocks(x, n, l, d) for x in (q, k, v))
-  maskb = mask.reshape(n, l, l)
+  # f32 cast happens XLA-side: Mosaic has no uint8->f32 lowering.
+  maskb = mask.reshape(n, l, l).astype(jnp.float32)
   spec = pl.BlockSpec((group, l, d), lambda i: (i, 0, 0),
                       memory_space=pltpu.VMEM)
   mask_spec = pl.BlockSpec((group, l, l), lambda i: (i, 0, 0),

@@ -1,15 +1,24 @@
-"""Pallas TPU kernels: whole-DP wavefront alignment scorer + custom VJP.
+"""Pallas TPU kernels: pipelined whole-DP wavefront alignment scorer.
 
-Runs the entire anti-diagonal recursion of the alignment score inside
-one VMEM-resident kernel per batch tile (fori_loop over diagonals),
-instead of a 200-step XLA while-loop whose per-step work is a few
-hundred lanes. `alignment_scores` is the forward scorer matching
+The alignment score is an anti-diagonal DP with a sequential dependence
+over k = i + j. The TPU-native formulation here makes the *grid* the
+diagonal axis: each grid step consumes one streamed diagonal block of
+the wavefrontified cost tensors (Pallas double-buffers the HBM->VMEM
+DMAs automatically) and updates carry rows held in VMEM scratch that
+persist across grid steps. The full batch rides the vector lanes of
+every step, so per-step work is a [B, m+1] vector op instead of the
+[batch_tile, m+1] slice a whole-DP-in-VMEM kernel is limited to, and
+VMEM holds two diagonals instead of the entire cost tensor.
+
+`alignment_scores` is the forward scorer matching
 ops/wavefront.alignment_scan semantics exactly; `alignment_scores_vjp`
-wraps it in a jax.custom_vjp whose backward is a second whole-DP kernel
-(forward-rows recompute into VMEM scratch + reverse adjoint sweep), so
-AlignmentLoss trains through Pallas end-to-end (the reference trains
-through this DP: losses_and_metrics.py:346-411). Validated against
-alignment_scan values and jax.grad in interpret mode.
+wraps it in a jax.custom_vjp whose backward runs two more pipelined
+kernels (forward recompute streaming every DP row to HBM, then a
+reverse-order adjoint sweep whose index maps walk the diagonals
+backwards), so AlignmentLoss trains through Pallas end-to-end (the
+reference trains through this DP: losses_and_metrics.py:346-411).
+Validated against alignment_scan values and jax.grad in interpret mode
+and on TPU hardware.
 """
 from __future__ import annotations
 
@@ -34,16 +43,16 @@ def _make_minop(loss_reg):
   return lambda t: -reg * jax.nn.logsumexp(-t / reg, axis=0)
 
 
-def _init_rows(bt, m, ins0, del_cost, inf):
-  """DP rows V[0], V[1] as full [BT, m+1] vectors (cells (i, k-i))."""
+def _init_rows(b, m, ins0, del_cost, inf):
+  """DP rows V[0], V[1] as full [B, m+1] vectors (cells (i, k-i))."""
   row0 = jnp.concatenate(
-      [jnp.zeros((bt, 1), jnp.float32),
-       jnp.full((bt, m), inf, jnp.float32)], axis=1,
+      [jnp.zeros((b, 1), jnp.float32),
+       jnp.full((b, m), inf, jnp.float32)], axis=1,
   )
   row1 = jnp.concatenate(
       [ins0[:, :1],
-       jnp.full((bt, 1), del_cost, jnp.float32),
-       jnp.full((bt, m - 1), inf, jnp.float32)], axis=1,
+       jnp.full((b, 1), del_cost, jnp.float32),
+       jnp.full((b, m - 1), inf, jnp.float32)], axis=1,
   )
   return row0, row1
 
@@ -65,37 +74,119 @@ def _dp_step(k, v_p2, v_p1, subs_k, ins_k, *, i_range, n, del_cost,
   return v_p2_next, v_new
 
 
-def _kernel(subs_ref, ins_ref, lens_ref, out_ref, *, m, n, del_cost,
-            loss_reg, inf):
-  # Blocks: subs [K, BT, m], ins [K+1, BT, m+1], lens [BT], out [BT].
-  bt = out_ref.shape[0]
+def _recompute_band(k, rows_p2, rows_p1, subs_k, ins_k, del_cost,
+                    loss_reg):
+  """Option stack + soft-min weights at diagonal k (backward pass)."""
+  t = jnp.stack([
+      rows_p2[:, :-1] + subs_k,
+      rows_p1[:, 1:] + ins_k[:, 1:],
+      rows_p1[:, :-1] + del_cost,
+  ])
+  if loss_reg is None:
+    tmin = jnp.min(t, axis=0, keepdims=True)
+    eq = (t == tmin).astype(jnp.float32)
+    w = eq / jnp.sum(eq, axis=0, keepdims=True)
+  else:
+    w = jax.nn.softmax(-t / jnp.float32(loss_reg), axis=0)
+  return w
+
+
+def _fwd_kernel(subs_ref, ins_ref, ins0_ref, lens_ref, out_ref, rows_ref,
+                v_p2_ref, v_p1_ref, v_opt_ref, *, m, n, del_cost,
+                loss_reg, inf, emit_rows):
+  """Grid step g computes diagonal k = g + 2.
+
+  Streams subs[k-2] ([1, B, m]) and ins[k-1] ([1, B, m+1]); carries
+  V[k-2], V[k-1] in VMEM scratch. With emit_rows, every V[k] is also
+  streamed back to HBM for the backward sweep.
+  """
+  del emit_rows
+  g = pl.program_id(0)
+  k = g + 2
+  b = v_p1_ref.shape[0]
   i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
   minop = _make_minop(loss_reg)
-
-  lens = lens_ref[:]  # [BT]
+  lens = lens_ref[:, 0]
   k_end = lens + n
   onehot_len = (
-      jax.lax.broadcasted_iota(jnp.int32, (bt, m + 1), 1)
-      == lens[:, None]
+      jax.lax.broadcasted_iota(jnp.int32, (b, m + 1), 1) == lens[:, None]
   ).astype(jnp.float32)
 
-  row0, row1 = _init_rows(bt, m, ins_ref[0], del_cost, inf)
-  v_opt = jnp.full((bt,), inf, jnp.float32)
+  @pl.when(g == 0)
+  def _init():
+    row0, row1 = _init_rows(b, m, ins0_ref[:], del_cost, inf)
+    v_p2_ref[:] = row0
+    v_p1_ref[:] = row1
+    v_opt_ref[:] = jnp.full((b, 1), inf, jnp.float32)
 
-  def body(k, carry):
-    v_p2, v_p1, v_opt = carry
-    v_p2_next, v_new = _dp_step(
-        k, v_p2, v_p1, subs_ref[k - 2], ins_ref[k - 1],
-        i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
-    )
-    v_at_len = jnp.sum(v_new * onehot_len, axis=1)
-    v_opt = jnp.where(k_end == k, v_at_len, v_opt)
-    return v_p2_next, v_new, v_opt
-
-  _, _, v_opt = jax.lax.fori_loop(
-      2, m + n + 1, body, (row0[:, :m], row1, v_opt)
+  v_p2_next, v_new = _dp_step(
+      k, v_p2_ref[:][:, :m], v_p1_ref[:], subs_ref[0], ins_ref[0],
+      i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
   )
-  out_ref[:] = v_opt
+  if rows_ref is not None:
+    rows_ref[0] = v_new
+  v_at_len = jnp.sum(v_new * onehot_len, axis=1, keepdims=True)
+  hit = (k_end == k)[:, None].astype(jnp.float32)
+  v_opt_ref[:] = v_opt_ref[:] * (1.0 - hit) + v_at_len * hit
+  v_p2_ref[:] = jnp.concatenate(
+      [v_p2_next, jnp.full((b, 1), inf, jnp.float32)], axis=1
+  )
+  v_p1_ref[:] = v_new
+  out_ref[:] = v_opt_ref[:]
+
+
+def _fwd_call(subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
+              interpret, emit_rows):
+  k_dim = subs_w.shape[0]  # m + n - 1
+  batch = subs_w.shape[1]
+  ins0 = ins_w[0]  # [B, m+1]
+  impl = functools.partial(
+      _fwd_kernel, m=m, n=n, del_cost=float(del_cost),
+      loss_reg=None if loss_reg is None else float(loss_reg),
+      inf=float(inf), emit_rows=emit_rows,
+  )
+  if emit_rows:
+    kernel = impl
+  else:
+    def kernel(subs, ins, ins0_r, lens, out, s1, s2, s3):
+      impl(subs, ins, ins0_r, lens, out, None, s1, s2, s3)
+  out_specs = [
+      pl.BlockSpec((batch, 1), lambda g: (0, 0),
+                   memory_space=pltpu.VMEM),
+  ]
+  out_shape = [jax.ShapeDtypeStruct((batch, 1), jnp.float32)]
+  if emit_rows:
+    # rows[k] for k = 2..m+n; rows[0:2] are closed-form, filled XLA-side.
+    out_specs.append(
+        pl.BlockSpec((1, batch, m + 1), lambda g: (g, 0, 0),
+                     memory_space=pltpu.VMEM)
+    )
+    out_shape.append(
+        jax.ShapeDtypeStruct((k_dim, batch, m + 1), jnp.float32)
+    )
+  results = pl.pallas_call(
+      kernel,
+      grid=(k_dim,),
+      in_specs=[
+          pl.BlockSpec((1, batch, m), lambda g: (g, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, batch, m + 1), lambda g: (g + 1, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((batch, m + 1), lambda g: (0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((batch, 1), lambda g: (0, 0),
+                       memory_space=pltpu.VMEM),
+      ],
+      out_specs=out_specs,
+      out_shape=out_shape,
+      scratch_shapes=[
+          pltpu.VMEM((batch, m + 1), jnp.float32),
+          pltpu.VMEM((batch, m + 1), jnp.float32),
+          pltpu.VMEM((batch, 1), jnp.float32),
+      ],
+      interpret=interpret,
+  )(subs_w, ins_w, ins0, seq_lens.astype(jnp.int32)[:, None])
+  return results
 
 
 def alignment_scores(
@@ -105,39 +196,25 @@ def alignment_scores(
     seq_lens: Array,
     loss_reg: Optional[float] = None,
     inf: float = 1e9,
-    batch_tile: int = 8,
     interpret: bool = False,
 ) -> Array:
   """Pallas twin of wavefront.alignment_scan (same args/semantics)."""
-  batch, m, n = subs_costs.shape
-  while batch % batch_tile:
-    batch_tile -= 1
-  subs_w = wavefront.wavefrontify(subs_costs)  # [K, B, m]
-  ins_w = wavefront.wavefrontify_vec(ins_costs, m + 1)  # [K+1, B, m+1]
-  k_dim = subs_w.shape[0]
+  _, m, n = subs_costs.shape
+  subs_w = wavefrontify32(subs_costs)  # [K, B, m]
+  ins_w = wavefrontify_vec32(ins_costs, m + 1)  # [K+1, B, m+1]
+  (out,) = _fwd_call(
+      subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
+      interpret, emit_rows=False,
+  )
+  return out[:, 0]
 
-  grid = (batch // batch_tile,)
-  return pl.pallas_call(
-      functools.partial(
-          _kernel, m=m, n=n, del_cost=float(del_cost),
-          loss_reg=None if loss_reg is None else float(loss_reg),
-          inf=float(inf),
-      ),
-      grid=grid,
-      in_specs=[
-          pl.BlockSpec((k_dim, batch_tile, m), lambda i: (0, i, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((k_dim + 1, batch_tile, m + 1), lambda i: (0, i, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((batch_tile,), lambda i: (i,),
-                       memory_space=pltpu.VMEM),
-      ],
-      out_specs=pl.BlockSpec((batch_tile,), lambda i: (i,),
-                             memory_space=pltpu.VMEM),
-      out_shape=jax.ShapeDtypeStruct((batch,), jnp.float32),
-      interpret=interpret,
-  )(subs_w.astype(jnp.float32), ins_w.astype(jnp.float32),
-    seq_lens.astype(jnp.int32))
+
+def wavefrontify32(t: Array) -> Array:
+  return wavefront.wavefrontify(t).astype(jnp.float32)
+
+
+def wavefrontify_vec32(v: Array, len1: int) -> Array:
+  return wavefront.wavefrontify_vec(v, len1).astype(jnp.float32)
 
 
 def _unwavefrontify(t_w: Array, n: int) -> Array:
@@ -161,104 +238,68 @@ def _unwavefrontify_vec_grad(v_w: Array, n: int) -> Array:
   return jnp.sum(jnp.transpose(v_w, (1, 0, 2))[:, i + j, i], axis=1)
 
 
-def _soft_weights(t: Array, loss_reg):
-  """d minop / d t for the [3, BT, m] option stack (softmax of -t/reg;
-  even split among ties for the hard min, matching reduce_min's JVP)."""
-  if loss_reg is None:
-    tmin = jnp.min(t, axis=0, keepdims=True)
-    eq = (t == tmin).astype(jnp.float32)
-    return eq / jnp.sum(eq, axis=0, keepdims=True)
-  return jax.nn.softmax(-t / jnp.float32(loss_reg), axis=0)
+def _bwd_kernel(subs_ref, ins_ref, rows_p2_ref, rows_p1_ref, lens_ref,
+                g_ref, dsubs_ref, dins_ref, dv1_ref, dA_ref, dB_ref, *,
+                m, n, del_cost, loss_reg, inf, k_total):
+  """Reverse adjoint sweep; grid step g handles diagonal k = (m+n) - g.
 
-
-def _bwd_kernel(subs_ref, ins_ref, lens_ref, g_ref, dsubs_ref, dins_ref,
-                rows_ref, *, m, n, del_cost, loss_reg, inf):
-  # Blocks: subs [K, BT, m], ins [K+1, BT, m+1], lens [BT], g [BT];
-  # outputs dsubs [K, BT, m], dins [K+1, BT, m+1];
-  # scratch rows [m+n+1, BT, m+1] holds every DP row V[k].
-  bt = g_ref.shape[0]
+  The index maps stream subs[k-2], ins[k-1] and the recorded DP rows
+  V[k-2], V[k-1] in *reverse* diagonal order. Carry: dA = adjoint of
+  V[k], dB = adjoint of V[k-1]. Step k spreads dA onto the three
+  predecessor rows weighted by the recomputed soft-min weights and
+  emits the cost-gradient diagonals dsubs[k-2], dins[k-1].
+  """
+  del inf
+  g = pl.program_id(0)
+  k = k_total - g
+  b = dA_ref.shape[0]
   i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
-  lens = lens_ref[:]
+  lens = lens_ref[:, 0]
   k_end = lens + n
   onehot_len = (
-      jax.lax.broadcasted_iota(jnp.int32, (bt, m + 1), 1) == lens[:, None]
+      jax.lax.broadcasted_iota(jnp.int32, (b, m + 1), 1) == lens[:, None]
   ).astype(jnp.float32)
 
-  minop = _make_minop(loss_reg)
+  @pl.when(g == 0)
+  def _init():
+    dA_ref[:] = jnp.zeros((b, m + 1), jnp.float32)
+    dB_ref[:] = jnp.zeros((b, m + 1), jnp.float32)
 
-  # Pass 1: forward recompute, materializing all rows in VMEM.
-  row0, row1 = _init_rows(bt, m, ins_ref[0], del_cost, inf)
-  rows_ref[0] = row0
-  rows_ref[1] = row1
-
-  def fwd_body(k, carry):
-    v_p2, v_p1 = carry  # [BT, m], [BT, m+1]
-    v_p2_next, v_new = _dp_step(
-        k, v_p2, v_p1, subs_ref[k - 2], ins_ref[k - 1],
-        i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
-    )
-    rows_ref[k] = v_new
-    return v_p2_next, v_new
-
-  jax.lax.fori_loop(2, m + n + 1, fwd_body, (row0[:, :m], row1))
-
-  # Pass 2: reverse adjoint sweep. Carry holds the adjoints of rows
-  # V[k] and V[k-1]; step k spreads dV[k] onto its three predecessors
-  # weighted by the (recomputed) soft-min weights and emits the cost
-  # gradients for diagonal k.
-  g = g_ref[:]
-  zeros_row = jnp.zeros((bt, m + 1), jnp.float32)
-
-  def bwd_body(idx, carry):
-    dA, dB = carry  # adjoints of V[k], V[k-1]
-    k = m + n - idx
-    valid = (k - i_range >= 0) & (k - i_range <= n)
-    inject = g[:, None] * onehot_len * (k_end == k)[:, None].astype(
-        jnp.float32
-    )
-    dA = jnp.where(valid, dA + inject, 0.0)
-    v_p2 = rows_ref[k - 2][:, :m]
-    v_p1 = rows_ref[k - 1]
-    subs_k = subs_ref[k - 2]
-    ins_k = ins_ref[k - 1]
-    t = jnp.stack([
-        v_p2 + subs_k,
-        v_p1[:, 1:] + ins_k[:, 1:],
-        v_p1[:, :-1] + del_cost,
-    ])
-    w = _soft_weights(t, loss_reg)
-    dbody = dA[:, 1:]
-    d_m = w[0] * dbody
-    d_i1 = w[1] * dbody
-    d_d = w[2] * dbody
-    dsubs_ref[k - 2] = d_m
-    dins_row = jnp.concatenate([dA[:, :1], d_i1], axis=1)
-    dins_ref[k - 1] = dins_row
-    zero_col = jnp.zeros((bt, 1), jnp.float32)
-    dB_new = dB + dins_row + jnp.concatenate([d_d, zero_col], axis=1)
-    dC = jnp.concatenate([d_m, zero_col], axis=1)
-    return dB_new, dC
-
-  dV1, _ = jax.lax.fori_loop(
-      0, m + n - 1, bwd_body, (zeros_row, zeros_row)
+  valid = (k - i_range >= 0) & (k - i_range <= n)
+  inject = g_ref[:, :1] * onehot_len * (k_end == k)[:, None].astype(
+      jnp.float32
   )
-  # V[1][0] = ins_w[0][:, 0] is the only input-dependent init entry.
-  dins_ref[0] = jnp.concatenate(
-      [dV1[:, :1], jnp.zeros((bt, m), jnp.float32)], axis=1
+  dA = jnp.where(valid, dA_ref[:] + inject, 0.0)
+
+  w = _recompute_band(
+      k, rows_p2_ref[0], rows_p1_ref[0], subs_ref[0], ins_ref[0],
+      del_cost, loss_reg,
   )
+  dbody = dA[:, 1:]
+  d_m = w[0] * dbody
+  d_i1 = w[1] * dbody
+  d_d = w[2] * dbody
+  dsubs_ref[0] = d_m
+  dins_row = jnp.concatenate([dA[:, :1], d_i1], axis=1)
+  dins_ref[0] = dins_row
+  zero_col = jnp.zeros((b, 1), jnp.float32)
+  dB_new = dB_ref[:] + dins_row + jnp.concatenate(
+      [d_d, zero_col], axis=1
+  )
+  dA_ref[:] = dB_new
+  dB_ref[:] = jnp.concatenate([d_m, zero_col], axis=1)
+  dv1_ref[:] = dB_new  # final value (at g = k_total - 2) is dV[1]
 
 
 def _scores_fwd_impl(subs_costs, ins_costs, seq_lens, del_cost, loss_reg,
-                     inf, batch_tile, interpret):
+                     inf, interpret):
   return alignment_scores(
       subs_costs, ins_costs, del_cost, seq_lens, loss_reg=loss_reg,
-      inf=inf, batch_tile=batch_tile,
-      interpret=pallas_util.resolve_interpret(interpret),
+      inf=inf, interpret=pallas_util.resolve_interpret(interpret),
   )
 
 
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def alignment_scores_vjp(
     subs_costs: Array,
     ins_costs: Array,
@@ -266,70 +307,107 @@ def alignment_scores_vjp(
     del_cost: float,
     loss_reg: Optional[float],
     inf: float = 1e9,
-    batch_tile: int = 8,
     interpret: Optional[bool] = None,
 ) -> Array:
   """Differentiable Pallas twin of wavefront.alignment_scan.
 
   Same scores as `alignment_scores`; gradients w.r.t. subs_costs and
-  ins_costs come from the whole-DP backward kernel.
+  ins_costs come from the pipelined backward kernels.
   """
   return _scores_fwd_impl(
       subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-      batch_tile, interpret,
+      interpret,
   )
 
 
 def _vjp_fwd(subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-             batch_tile, interpret):
+             interpret):
   out = _scores_fwd_impl(
       subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-      batch_tile, interpret,
+      interpret,
   )
   return out, (subs_costs, ins_costs, seq_lens)
 
 
-def _vjp_bwd(del_cost, loss_reg, inf, batch_tile, interpret, res, g):
+def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
   import numpy as np
 
   subs_costs, ins_costs, seq_lens = res
   batch, m, n = subs_costs.shape
-  bt = batch_tile
-  while batch % bt:
-    bt -= 1
-  subs_w = wavefront.wavefrontify(subs_costs).astype(jnp.float32)
-  ins_w = wavefront.wavefrontify_vec(ins_costs, m + 1).astype(jnp.float32)
-  k_dim = subs_w.shape[0]
+  interp = pallas_util.resolve_interpret(interpret)
+  subs_w = wavefrontify32(subs_costs)
+  ins_w = wavefrontify_vec32(ins_costs, m + 1)
+  k_dim = subs_w.shape[0]  # m + n - 1
+  k_total = m + n
 
-  d_subs_w, d_ins_w = pl.pallas_call(
+  # Pass 1: forward recompute, streaming every DP row V[k] to HBM.
+  _, rows_kernel = _fwd_call(
+      subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf, interp,
+      emit_rows=True,
+  )
+  row0, row1 = _init_rows(batch, m, ins_w[0], float(del_cost), float(inf))
+  rows = jnp.concatenate(
+      [row0[None], row1[None], rows_kernel], axis=0
+  )  # [m+n+1, B, m+1], rows[k] = V[k]
+
+  # Pass 2: reverse sweep. Step g handles k = k_total - g; the index
+  # maps walk subs/ins/rows diagonals backwards.
+  d_subs_w, d_ins_w, dv1 = pl.pallas_call(
       functools.partial(
           _bwd_kernel, m=m, n=n, del_cost=float(del_cost),
           loss_reg=None if loss_reg is None else float(loss_reg),
-          inf=float(inf),
+          inf=float(inf), k_total=k_total,
       ),
-      grid=(batch // bt,),
+      grid=(k_dim,),
       in_specs=[
-          pl.BlockSpec((k_dim, bt, m), lambda i: (0, i, 0),
+          pl.BlockSpec((1, batch, m),
+                       lambda gi: (k_total - gi - 2, 0, 0),
                        memory_space=pltpu.VMEM),
-          pl.BlockSpec((k_dim + 1, bt, m + 1), lambda i: (0, i, 0),
+          pl.BlockSpec((1, batch, m + 1),
+                       lambda gi: (k_total - gi - 1, 0, 0),
                        memory_space=pltpu.VMEM),
-          pl.BlockSpec((bt,), lambda i: (i,), memory_space=pltpu.VMEM),
-          pl.BlockSpec((bt,), lambda i: (i,), memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, batch, m + 1),
+                       lambda gi: (k_total - gi - 2, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((1, batch, m + 1),
+                       lambda gi: (k_total - gi - 1, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((batch, 1), lambda gi: (0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((batch, 1), lambda gi: (0, 0),
+                       memory_space=pltpu.VMEM),
       ],
       out_specs=[
-          pl.BlockSpec((k_dim, bt, m), lambda i: (0, i, 0),
+          pl.BlockSpec((1, batch, m),
+                       lambda gi: (k_total - gi - 2, 0, 0),
                        memory_space=pltpu.VMEM),
-          pl.BlockSpec((k_dim + 1, bt, m + 1), lambda i: (0, i, 0),
+          pl.BlockSpec((1, batch, m + 1),
+                       lambda gi: (k_total - gi - 1, 0, 0),
+                       memory_space=pltpu.VMEM),
+          pl.BlockSpec((batch, m + 1), lambda gi: (0, 0),
                        memory_space=pltpu.VMEM),
       ],
       out_shape=[
           jax.ShapeDtypeStruct((k_dim, batch, m), jnp.float32),
           jax.ShapeDtypeStruct((k_dim + 1, batch, m + 1), jnp.float32),
+          jax.ShapeDtypeStruct((batch, m + 1), jnp.float32),
       ],
-      scratch_shapes=[pltpu.VMEM((m + n + 1, bt, m + 1), jnp.float32)],
-      interpret=pallas_util.resolve_interpret(interpret),
-  )(subs_w, ins_w, seq_lens.astype(jnp.int32), g.astype(jnp.float32))
+      scratch_shapes=[
+          pltpu.VMEM((batch, m + 1), jnp.float32),
+          pltpu.VMEM((batch, m + 1), jnp.float32),
+      ],
+      interpret=interp,
+  )(subs_w, ins_w, rows, rows, seq_lens.astype(jnp.int32)[:, None],
+    g.astype(jnp.float32)[:, None])
 
+  # The kernel never visits dins block 0 (its diagonal index stops at
+  # 1); V[1][0] = ins_w[0][:, 0] is the only input-dependent init
+  # entry, so dins[0] comes from the dV[1] carry.
+  d_ins_w = d_ins_w.at[0].set(
+      jnp.concatenate(
+          [dv1[:, :1], jnp.zeros((batch, m), jnp.float32)], axis=1
+      )
+  )
   d_subs = _unwavefrontify(d_subs_w, n).astype(subs_costs.dtype)
   d_ins = _unwavefrontify_vec_grad(d_ins_w, n).astype(ins_costs.dtype)
   d_lens = np.zeros(seq_lens.shape, jax.dtypes.float0)

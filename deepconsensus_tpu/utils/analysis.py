@@ -84,10 +84,12 @@ def edit_distance(s1: str, s2: str) -> int:
   """
   s1 = s1.replace(constants.GAP, '')
   s2 = s2.replace(constants.GAP, '')
-  if len(s1) > len(s2):
+  # Vector axis = the longer string; the Python loop runs over the
+  # shorter one.
+  if len(s1) < len(s2):
     s1, s2 = s2, s1
-  if not s1:
-    return len(s2)
+  if not s2:
+    return len(s1)
   a = np.frombuffer(s1.encode('ascii'), dtype=np.uint8)
   b = np.frombuffer(s2.encode('ascii'), dtype=np.uint8)
   prev = np.arange(a.size + 1, dtype=np.int64)
