@@ -229,7 +229,9 @@ def main():
   # training keeps state on device, so the scalar-output timing is the
   # honest device number.
   for name, overrides in (
-      ('train_b256_scan', {}),
+      # The default is auto (None -> Pallas on TPU); the scan baseline
+      # must pin False or the A/B times the same kernel twice.
+      ('train_b256_scan', {'use_pallas_wavefront': False}),
       ('train_b256_pallas_vjp', {'use_pallas_wavefront': True}),
       ('train_b256_pallas_attn', {'use_pallas_wavefront': True,
                                   'use_pallas_attention': True}),

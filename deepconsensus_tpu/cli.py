@@ -24,7 +24,12 @@ def _coerce_override(raw: str, current):
     if isinstance(current, cast):
       return cast(raw)
   if current is None:
-    # Untyped (e.g. band_width defaults to None): best-effort numeric.
+    # Untyped (e.g. band_width / use_pallas_wavefront default to
+    # None): best-effort bool, then numeric.
+    if raw.lower() in ('true', 'yes'):
+      return True
+    if raw.lower() in ('false', 'no'):
+      return False
     for cast in (int, float):
       try:
         return cast(raw)

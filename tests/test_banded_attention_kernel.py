@@ -143,3 +143,76 @@ def test_model_trains_with_pallas_attention():
   state, m = step(state, {'rows': rows, 'label': label})
   assert np.isfinite(l1) and np.isfinite(float(m['loss']))
   assert float(m['loss']) != l1  # params actually updated
+
+
+@pytest.mark.parametrize('l,win', [
+    (100, 12),    # flagship window size
+    (256, 12),    # multi-block queries, single-block band reach
+    (257, 30),    # non-multiple length + padded tail rows
+    (384, 130),   # band wider than one key block (w_blocks > 1)
+    (192, None),  # full attention via the flash path
+])
+def test_flash_band_matches_reference(l, win):
+  from deepconsensus_tpu.ops import flash_band_attention as fba
+
+  q, k, v = make_qkv(b=1, l=l, h=2, d=64, seed=3)
+  want = ba.reference_banded_attention(q, k, v, win)
+  got = fba.flash_band_attention(q, k, v, win, interpret=True)
+  np.testing.assert_allclose(
+      np.asarray(got), np.asarray(want), atol=2e-5, rtol=1e-5
+  )
+
+
+def test_flash_band_bf16():
+  """bf16 inputs against the f32 truth: the kernel accumulates in f32,
+  so it tracks the f32 reference *closer* than the unfused bf16 path
+  does (which rounds the softmax weights to bf16 before PV)."""
+  from deepconsensus_tpu.ops import flash_band_attention as fba
+
+  qf, kf, vf = make_qkv(b=2, l=160, d=64)
+  q, k, v = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+  want_f32 = np.asarray(ba.reference_banded_attention(qf, kf, vf, 12))
+  got = np.asarray(
+      fba.flash_band_attention(q, k, v, 12, interpret=True), np.float32
+  )
+  unfused_bf16 = np.asarray(
+      ba.reference_banded_attention(q, k, v, 12), np.float32
+  )
+  kernel_err = np.abs(got - want_f32).max()
+  unfused_err = np.abs(unfused_bf16 - want_f32).max()
+  # Both paths share the bf16 input rounding (~1e-1 on these scales);
+  # the kernel must not add error beyond it, and its f32 accumulation
+  # should track the truth at least as well as the unfused bf16 path.
+  assert kernel_err < 1e-1
+  assert kernel_err <= unfused_err
+
+
+def test_flash_kernel_in_long_window_model():
+  """use_pallas_attention at L>128 routes inference through the flash
+  kernel and matches the unfused model output."""
+  import jax
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 32
+    params.max_length = 192
+  rows = jnp.zeros((2, params.total_rows, params.max_length, 1))
+  rng = np.random.default_rng(0)
+  rows = jnp.asarray(
+      rng.integers(0, 4, size=rows.shape).astype(np.float32)
+  )
+  model = model_lib.get_model(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  base = model.apply(variables, rows)
+  with params.unlocked():
+    params.use_pallas_attention = True
+  model_p = model_lib.get_model(params)
+  flash = model_p.apply(variables, rows)
+  np.testing.assert_allclose(
+      np.asarray(flash), np.asarray(base), atol=1e-5
+  )
