@@ -90,6 +90,19 @@ class InferenceOptions:
   )
 
 
+_SN_ROWS = 4  # trailing rows: per-window SN constants (layout: pileup.py)
+
+
+def _assemble_rows(main_u8: jnp.ndarray, sn: jnp.ndarray) -> jnp.ndarray:
+  """Device-side inverse of dispatch()'s compact split: uint8 rows ->
+  f32, SN scalars re-broadcast across the window."""
+  b, _, l, _ = main_u8.shape
+  sn_rows = jnp.broadcast_to(
+      sn.astype(jnp.float32)[:, :, None, None], (b, _SN_ROWS, l, 1)
+  )
+  return jnp.concatenate([main_u8.astype(jnp.float32), sn_rows], axis=1)
+
+
 class ModelRunner:
   """Jitted forward pass producing (bases, quality scores) per window.
 
@@ -129,7 +142,8 @@ class ModelRunner:
         }
     model = model_lib.get_model(params)
 
-    def forward(variables, rows):
+    def forward(variables, main_u8, sn):
+      rows = _assemble_rows(main_u8, sn)
       preds = model.apply(variables, rows)
       pred_ids = jnp.argmax(preds, axis=-1).astype(jnp.int32)
       max_prob = jnp.max(preds, axis=-1)
@@ -148,7 +162,7 @@ class ModelRunner:
         forward,
         # Variables keep the placement __init__ gave them (replicated,
         # or model-axis sharded under tp>1).
-        in_shardings=(None, batch_sh),
+        in_shardings=(None, batch_sh, batch_sh),
         out_shardings=(batch_sh, batch_sh),
     )
 
@@ -197,8 +211,8 @@ class ModelRunner:
     runner.options = options
 
     @jax.jit
-    def forward(_variables, rows):
-      preds = serving(rows)
+    def forward(_variables, main_u8, sn):
+      preds = serving(_assemble_rows(main_u8, sn))
       return (
           jnp.argmax(preds, axis=-1).astype(jnp.int32),
           jnp.max(preds, axis=-1),
@@ -212,13 +226,23 @@ class ModelRunner:
 
     Pads to the fixed compiled batch shape and returns device arrays
     immediately so the next batch's host work overlaps device compute.
+
+    Transfer is compact: every non-SN row holds clip-bounded integers
+    (bases/ccs 0-4, pw/ip <= PW_MAX/IP_MAX = 255, strand 0-2, ccs_bq
+    <= 93), and the 4 SN rows are per-window constants, so the batch
+    ships as uint8 rows + [B, 4] float SN scalars (~4x less than f32
+    rows over PCIe/tunnel) and reassembles losslessly on device.
     """
     n = rows.shape[0]
     batch = self.options.batch_size
     if n < batch:
       pad = np.zeros((batch - n,) + rows.shape[1:], rows.dtype)
       rows = np.concatenate([rows, pad])
-    pred_ids, max_prob = self._forward(self.variables, jnp.asarray(rows))
+    main_u8 = rows[:, :-_SN_ROWS].astype(np.uint8)
+    sn = np.ascontiguousarray(rows[:, -_SN_ROWS:, 0, 0].astype(np.float32))
+    pred_ids, max_prob = self._forward(
+        self.variables, jnp.asarray(main_u8), jnp.asarray(sn)
+    )
     return pred_ids, max_prob, n
 
   def finalize(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
