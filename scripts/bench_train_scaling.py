@@ -1,0 +1,92 @@
+"""Train-step throughput vs batch size on the available chip.
+
+Times the full train step (forward + AlignmentLoss DP + LAMB update)
+at several batch sizes with the Pallas wavefront loss (the TPU
+default), transfer-free timing: the step returns only scalars, with a
+parameter fingerprint keeping the update live against DCE. Prints one
+JSON line per batch so a tunnel hang keeps completed rows.
+"""
+import argparse
+import json
+import time
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--batches', type=int, nargs='+',
+                  default=[256, 512, 1024])
+  ap.add_argument('--steps', type=int, default=6)
+  ap.add_argument('--scan', action='store_true',
+                  help='pin the lax.scan DP instead of Pallas')
+  ap.add_argument('--cpu', action='store_true')
+  args = ap.parse_args()
+
+  import jax
+
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  import numpy as np
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import train as train_lib
+
+  for batch in args.batches:
+    tp = config_lib.get_config('transformer_learn_values+test')
+    config_lib.finalize_params(tp)
+    with tp.unlocked():
+      tp.batch_size = batch
+      tp.use_pallas_wavefront = False if args.scan else None
+    trainer = train_lib.Trainer(
+        params=tp, out_dir='/tmp/dc_bench_train_scaling', mesh=None
+    )
+    state = trainer.init_state(steps_total=100)
+    loss_obj = trainer.loss_fn
+    rng = np.random.default_rng(2)
+    rows = np.zeros((batch, tp.total_rows, tp.max_length, 1), np.float32)
+    mp = tp.max_passes
+    rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)
+    rows[:, mp:3 * mp] = rng.integers(0, 256, size=rows[:, mp:3 * mp].shape)
+    rows[:, 3 * mp:4 * mp] = rng.integers(0, 3, size=rows[:, :mp].shape)
+    rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)
+    rows[:, 4 * mp + 1:] = rng.integers(0, 501,
+                                        size=rows[:, 4 * mp + 1:].shape)
+    rows_t = jnp.asarray(rows)
+    label = jnp.asarray(
+        rng.integers(0, 5, size=(batch, tp.max_length)), jnp.int32)
+
+    def step_scalar(state, rows, label):
+      rng_step = jax.random.fold_in(state.dropout_rng, state.step)
+
+      def loss_of(p):
+        preds = state.apply_fn(
+            {'params': p}, rows, train=True, rngs={'dropout': rng_step}
+        )
+        return loss_obj(label, preds)
+
+      loss, grads = jax.value_and_grad(loss_of)(state.params)
+      new_state = state.apply_gradients(grads=grads)
+      fp = sum(jnp.sum(x) for x in jax.tree.leaves(new_state.params))
+      return loss, fp
+
+    step_fn = jax.jit(step_scalar)
+    row = {'batch': batch,
+           'dp': 'scan' if args.scan else 'pallas(auto)'}
+    try:
+      t0 = time.perf_counter()
+      out = step_fn(state, rows_t, label)
+      [np.asarray(o) for o in out]
+      row['compile_plus_first_step_s'] = round(time.perf_counter() - t0, 1)
+      t0 = time.perf_counter()
+      for i in range(args.steps):
+        out = step_fn(state, rows_t.at[0, 0, 0, 0].set(float(i)), label)
+        vals = [np.asarray(o) for o in out]
+      dt = time.perf_counter() - t0
+      row['examples_per_sec'] = round(batch * args.steps / dt, 1)
+      row['loss'] = round(float(vals[0]), 3)
+    except Exception as e:
+      row['error'] = repr(e)[:200]
+    print(json.dumps(row), flush=True)
+
+
+if __name__ == '__main__':
+  main()
