@@ -249,46 +249,44 @@ class Pileup:
       yield Pileup(self.name, reads, self.layout, overflow=overflow)
 
   # ------------------------------------------------------------------
-  def extract_features(self) -> np.ndarray:
+  def extract_features(self, min_width: int = 0) -> np.ndarray:
     """Stacks the window into the [rows, width, 1] tensor
-    (reference: pre_lib.py:704-744)."""
+    (reference: pre_lib.py:704-744). min_width over-allocates columns
+    (zero-filled past the pileup) so the batched window path can
+    reshape in place instead of re-copying into a padded buffer."""
     layout = self.layout
     n_subreads = self.n_subreads
     data = np.zeros(
-        (layout.tensor_height, self.width), dtype=constants.NP_DATA_TYPE
+        (layout.tensor_height, max(self.width, min_width)),
+        dtype=constants.NP_DATA_TYPE,
     )
+    body = data[:, : self.width]
     keep = self.subreads[: layout.max_passes]
     if keep:
-      data[layout.indices('bases', n_subreads)] = np.stack(
+      body[layout.indices('bases', n_subreads)] = np.stack(
           [r.bases for r in keep]
       )
-      data[layout.indices('pw', n_subreads)] = np.stack([r.pw for r in keep])
-      data[layout.indices('ip', n_subreads)] = np.stack([r.ip for r in keep])
+      body[layout.indices('pw', n_subreads)] = np.stack([r.pw for r in keep])
+      body[layout.indices('ip', n_subreads)] = np.stack([r.ip for r in keep])
       strand_col = np.array([float(int(r.strand)) for r in keep],
                             dtype=constants.NP_DATA_TYPE)
-      data[layout.indices('strand', n_subreads)] = np.repeat(
-          strand_col[:, None], self.width, axis=1
-      )
-    data[layout.indices('ccs')] = self.ccs.bases
+      body[layout.indices('strand', n_subreads)] = strand_col[:, None]
+    body[layout.indices('ccs')] = self.ccs.bases
     if layout.use_ccs_bq:
-      data[layout.indices('ccs_bq')] = self.ccs.base_quality_scores
+      body[layout.indices('ccs_bq')] = self.ccs.base_quality_scores
     if self.subreads:
-      data[layout.indices('sn')] = np.repeat(
-          np.asarray(self.subreads[0].sn, dtype=constants.NP_DATA_TYPE)[
-              :, None
-          ],
-          self.width,
-          axis=1,
-      )
+      body[layout.indices('sn')] = np.asarray(
+          self.subreads[0].sn, dtype=constants.NP_DATA_TYPE
+      )[:, None]
     return data[:, :, None]
 
-  def full_matrix(self) -> np.ndarray:
+  def full_matrix(self, min_width: int = 0) -> np.ndarray:
     """Whole-ZMW stacked feature matrix [tensor_height, width].
 
     Windows are column slices of this matrix (plus padding rules), so
     building it once replaces per-window re-stacking.
     """
-    return self.extract_features()[:, :, 0]
+    return self.extract_features(min_width)[:, :, 0]
 
   def iter_window_features(self) -> Iterator[Dict[str, Any]]:
     """Fast inference path: window feature dicts via slices of the
@@ -299,7 +297,13 @@ class Pileup:
     self.counter = Counter()
     layout = self.layout
     max_length = layout.max_length
-    matrix = self.full_matrix()
+    if self.window_widths is None:
+      # Over-allocate to the padded window total up front so the
+      # batched branch below reshapes the matrix in place.
+      n_batched = (self.ccs_width + max_length - 1) // max_length
+      matrix = self.full_matrix(min_width=n_batched * max_length)
+    else:
+      matrix = self.full_matrix()
     ccs = self.ccs
     ccs_idx = ccs.ccs_idx
     bq = ccs.base_quality_scores
@@ -316,6 +320,70 @@ class Pileup:
         np.asarray(self.subreads[0].sn, dtype=constants.NP_DATA_TYPE)
         if self.subreads else np.zeros(4, dtype=constants.NP_DATA_TYPE)
     )
+
+    if self.window_widths is None:
+      # Regular windows are contiguous stride-max_length column slices
+      # of the whole-ZMW matrix: build every window with ONE
+      # pad+reshape and vectorized per-window metadata instead of
+      # ~(ccs_width/100) small-array slice/copy/min calls (the
+      # measured host featurization hot spot). Yielded tensors are
+      # views into the batched array.
+      w = max_length
+      n = n_batched
+      if n == 0:
+        return
+      total = n * w
+      cols = min(self.width, total)
+      # matrix was over-allocated to >= total columns (zero-filled
+      # past the pileup); apply the padding rules to the tail in
+      # place: strand/sn rows repeat, ccs_bq pads with -1 (see
+      # extract_features + AlignedRead.pad).
+      padded = matrix[:, :total]
+      if cols < total:
+        padded[strand_rows, cols:] = strand_col[:, None]
+        padded[sn_rows, cols:] = sn_col[:, None]
+        if layout.use_ccs_bq:
+          padded[layout.indices('ccs_bq'), cols:] = -1
+      windows3d = padded.reshape(layout.tensor_height, n, w)
+
+      idx_pad = np.full(total, -1, dtype=np.int64)
+      m = min(len(ccs_idx), total)
+      idx_pad[:m] = ccs_idx[:m]
+      idx_w = idx_pad.reshape(n, w)
+      big = np.iinfo(np.int64).max
+      window_pos = np.where(idx_w >= 0, idx_w, big).min(axis=1)
+      has_cov = window_pos != big
+
+      bq_pad = np.full(total, -1, dtype=np.int64)
+      if has_bq:
+        m = min(len(bq), total)
+        bq_pad[:m] = bq[:m]
+      bq_w = bq_pad.reshape(n, w)
+
+      self.counter[f'example_width_bucket_{w}'] += n
+      n_cov = int(has_cov.sum())
+      if n - n_cov:  # += 0 would still materialize the Counter key
+        self.counter['n_examples_no_ccs_idx'] += n - n_cov
+      if n_cov:
+        self.counter['n_examples_skip_large_windows_keep'] += n_cov
+      invariant = {
+          'subreads/num_passes': self.keep_subreads,
+          'name': self.name,
+          'overflow': False,
+          'ec': ccs.ec,
+          'np_num_passes': ccs.np_num_passes,
+          'rq': ccs.rq,
+          'rg': ccs.rg,
+      }
+      for i in range(n):
+        if not has_cov[i]:
+          continue
+        fd = dict(invariant)
+        fd['subreads'] = windows3d[:, i, :, None]
+        fd['window_pos'] = int(window_pos[i])
+        fd['ccs_base_quality_scores'] = bq_w[i]
+        yield fd
+      return
 
     start = 0
     for window_width in self.calculate_windows(max_length):
