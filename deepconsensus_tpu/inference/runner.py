@@ -441,9 +441,6 @@ def run_model_on_windows(
   """Formats, batches, and runs windows through the model
   (reference: quick_inference.py:341-415)."""
   outputs: List[stitch.DCModelOutput] = []
-  processed = [
-      data_lib.process_feature_dict(fd, params) for fd in feature_dicts
-  ]
 
   # Double-buffered: dispatch batch i+1 before finalizing batch i so
   # host-side stacking/quality math overlaps device compute.
@@ -467,9 +464,10 @@ def run_model_on_windows(
           )
       )
 
-  for start in range(0, len(processed), options.batch_size):
-    chunk = processed[start : start + options.batch_size]
-    rows = np.stack([c['rows'] for c in chunk])
+  for start in range(0, len(feature_dicts), options.batch_size):
+    chunk = feature_dicts[start : start + options.batch_size]
+    raw = np.stack([c['subreads'] for c in chunk])
+    rows = data_lib.format_rows_batch(raw, params)
     pending.append((chunk, runner.dispatch(rows)))
     if len(pending) > 1:
       drain(pending.pop(0))
