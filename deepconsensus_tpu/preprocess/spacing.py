@@ -35,6 +35,87 @@ from deepconsensus_tpu.preprocess.alignment import AlignedRead
 Cigar = constants.Cigar
 
 
+def _column_layout_batched(
+    nonlabel: List[AlignedRead],
+) -> Tuple[List[np.ndarray], np.ndarray, int]:
+  """_column_layout with every per-read loop flattened into segment
+  ops over the reads' concatenated positions (per-read cumsums become
+  global cumsums minus per-read offsets; per-read insertion-run
+  bincounts become run-length detection on (read, boundary) change
+  points + np.maximum.at). Same return contract, ~5x fewer numpy
+  dispatches on typical 10-subread ZMWs."""
+  n_reads = len(nonlabel)
+  lens = np.array([len(r) for r in nonlabel], dtype=np.int64)
+  total = int(lens.sum())
+  if total == 0:
+    return [np.empty(0, np.int64) for _ in nonlabel], np.zeros(0, bool), 0
+  ends = np.cumsum(lens)
+  read_idx = np.repeat(np.arange(n_reads), lens)
+
+  cigar = np.concatenate([r.cigar for r in nonlabel])
+  is_ins = cigar == Cigar.INS
+  nonins = ~is_ins
+
+  # boundary of each position = #non-insertions before it IN ITS READ.
+  cs = np.cumsum(nonins)
+  cs_end = cs[ends - 1]
+  cs_before = np.concatenate([[0], cs_end[:-1]])
+  boundary = cs - cs_before[read_idx] - nonins
+  nonins_per_read = cs_end - cs_before
+  b_max = int(nonins_per_read.max())
+
+  # maxins[b]: widest insertion run at boundary b across reads.
+  # Insertion runs are maximal stretches of ins positions sharing one
+  # (read, boundary); positions are ordered, so change points find them.
+  maxins = np.zeros(b_max + 1, dtype=np.int64)
+  ins_pos = np.flatnonzero(is_ins)
+  if ins_pos.size:
+    key = read_idx[ins_pos] * np.int64(b_max + 2) + boundary[ins_pos]
+    change = np.empty(len(ins_pos), dtype=bool)
+    change[0] = True
+    change[1:] = key[1:] != key[:-1]
+    run_start_idx = np.flatnonzero(change)
+    run_len = np.diff(np.append(run_start_idx, len(ins_pos)))
+    run_boundary = boundary[ins_pos[run_start_idx]]
+    np.maximum.at(maxins, run_boundary, run_len)
+    # rank of each insertion within its run (left-aligned placement).
+    run_starts_bcast = np.maximum.accumulate(
+        np.where(change, np.arange(len(ins_pos)), 0)
+    )
+    rank = np.arange(len(ins_pos)) - run_starts_bcast
+
+  cum = np.cumsum(maxins)  # inclusive prefix sum
+  # Non-insertion position b sits at column b + cum[b]; the insertion
+  # block of boundary b starts at C(b) = b + cum[b] - maxins[b].
+  block_start = np.arange(b_max + 1) + cum - maxins
+
+  cols = np.empty(total, dtype=np.int64)
+  b_idx = boundary[nonins]
+  cols[nonins] = b_idx + cum[b_idx]
+  if ins_pos.size:
+    cols[ins_pos] = block_start[boundary[ins_pos]] + rank
+
+  nonempty = lens > 0
+  last_cols = np.zeros(n_reads, dtype=np.int64)
+  last_cols[nonempty] = cols[ends[nonempty] - 1] + 1
+  total_cols = int(last_cols.max())
+
+  cols_per_read = [
+      cols[ends[i] - lens[i] : ends[i]] for i in range(n_reads)
+  ]
+
+  is_ins_col = np.zeros(total_cols, dtype=bool)
+  nz = np.flatnonzero(maxins)
+  if nz.size:
+    starts = block_start[nz]
+    widths = maxins[nz]
+    offsets = np.arange(int(widths.sum()))
+    group_starts = np.repeat(np.cumsum(widths) - widths, widths)
+    ins_cols = np.repeat(starts, widths) + (offsets - group_starts)
+    is_ins_col[ins_cols[ins_cols < total_cols]] = True
+  return cols_per_read, is_ins_col, total_cols
+
+
 def _column_layout(
     nonlabel: List[AlignedRead],
 ) -> Tuple[List[np.ndarray], np.ndarray, int]:
@@ -209,6 +290,63 @@ def _apply_spacing(
   )
 
 
+def _apply_spacing_batched(
+    reads: List[AlignedRead],
+    cols_per_read: List[np.ndarray],
+    width: int,
+) -> List[AlignedRead]:
+  """_apply_spacing for a batch of non-label reads: one [n_reads,
+  width] allocation and one fancy-index scatter per field instead of
+  per-read buffers (base qualities, present only on the CCS read,
+  keep the per-read path)."""
+  n_reads = len(reads)
+  lens = np.array([len(c) for c in cols_per_read], dtype=np.int64)
+  row_idx = np.repeat(np.arange(n_reads), lens)
+  flat_cols = (
+      np.concatenate(cols_per_read) if n_reads else np.empty(0, np.int64)
+  )
+  bases2d = np.zeros((n_reads, width), dtype=np.uint8)
+  pw2d = np.zeros((n_reads, width), dtype=np.int32)
+  ip2d = np.zeros((n_reads, width), dtype=np.int32)
+  ccs_idx2d = np.full((n_reads, width), -1, dtype=np.int64)
+  if flat_cols.size:
+    bases2d[row_idx, flat_cols] = np.concatenate(
+        [r.bases for r in reads]
+    )
+    pw2d[row_idx, flat_cols] = np.concatenate([r.pw for r in reads])
+    ip2d[row_idx, flat_cols] = np.concatenate([r.ip for r in reads])
+    ccs_idx2d[row_idx, flat_cols] = np.concatenate(
+        [r.ccs_idx for r in reads]
+    )
+  out = []
+  for i, (read, cols) in enumerate(zip(reads, cols_per_read)):
+    bq = read.base_quality_scores
+    if bq.size and bq.any():
+      spaced_bq = np.full(width, -1, dtype=np.int64)
+      spaced_bq[cols] = bq
+      bq = spaced_bq
+    out.append(
+        AlignedRead(
+            name=read.name,
+            bases=bases2d[i],
+            cigar=read.cigar,
+            pw=pw2d[i],
+            ip=ip2d[i],
+            sn=read.sn,
+            strand=read.strand,
+            ec=read.ec,
+            np_num_passes=read.np_num_passes,
+            rq=read.rq,
+            rg=read.rg,
+            ccs_idx=ccs_idx2d[i],
+            base_quality_scores=bq,
+            truth_idx=read.truth_idx,
+            truth_range=read.truth_range,
+        )
+    )
+  return out
+
+
 def space_out_reads(reads: List[AlignedRead]) -> List[AlignedRead]:
   """Spaces out a ZMW's reads (subreads + ccs [+ label]) into a pileup.
 
@@ -218,7 +356,7 @@ def space_out_reads(reads: List[AlignedRead]) -> List[AlignedRead]:
   nonlabel = reads[:-1] if has_label else reads
   label: Optional[AlignedRead] = reads[-1] if has_label else None
 
-  cols_per_read, is_ins_col, total_cols = _column_layout(nonlabel)
+  cols_per_read, is_ins_col, total_cols = _column_layout_batched(nonlabel)
   widths = [
       int(c[-1]) + 1 if len(c) else 0 for c in cols_per_read
   ]
@@ -228,10 +366,7 @@ def space_out_reads(reads: List[AlignedRead]) -> List[AlignedRead]:
     widths.append(label_width)
   max_len = max(widths) if widths else 0
 
-  spaced = [
-      _apply_spacing(r, cols, max_len)
-      for r, cols in zip(nonlabel, cols_per_read)
-  ]
+  spaced = _apply_spacing_batched(nonlabel, cols_per_read, max_len)
   if label is not None:
     spaced.append(_apply_spacing(label, label_cols, max_len))
   return spaced

@@ -161,3 +161,23 @@ def test_fuzz_spacing_matches_reference_simulation(seed, with_label):
     np.testing.assert_array_equal(
         got.bases, want, err_msg=f'seed={seed} read={i}'
     )
+
+
+@pytest.mark.parametrize('seed', range(15))
+def test_batched_column_layout_equals_per_read(seed):
+  """The segment-op batched layout must reproduce the per-read-loop
+  layout exactly (cols per read, insertion columns, total width)."""
+  from deepconsensus_tpu.preprocess import spacing
+
+  rng = np.random.default_rng(seed)
+  ccs_len = int(rng.integers(1, 40))
+  reads = [
+      random_read(rng, ccs_len, name=f'm/1/{i}')
+      for i in range(int(rng.integers(1, 8)))
+  ]
+  want_cols, want_ins, want_total = spacing._column_layout(reads)
+  got_cols, got_ins, got_total = spacing._column_layout_batched(reads)
+  assert got_total == want_total
+  np.testing.assert_array_equal(got_ins, want_ins)
+  for g, w in zip(got_cols, want_cols):
+    np.testing.assert_array_equal(g, w)
