@@ -26,30 +26,7 @@ def format_rows(
 ) -> np.ndarray:
   """Clips PW/IP/SN rows and crops passes to the model's max_passes
   (reference format_rows: data_providers.py:128-184)."""
-  example_layout = layout_from_shape(subreads.shape, params.use_ccs_bq)
-  (base_r, pw_r, ip_r, strand_r, ccs_r, ccs_bq_r, sn_r) = row_indices(
-      example_layout.max_passes, params.use_ccs_bq
-  )
-  keep = params.max_passes
-
-  def rows_of(r, cap=None):
-    block = subreads[r[0]:r[1]]
-    return block[:cap] if cap else block
-
-  base_rows = rows_of(base_r, keep)
-  pw_rows = np.clip(rows_of(pw_r, keep), 0, params.PW_MAX)
-  ip_rows = np.clip(rows_of(ip_r, keep), 0, params.IP_MAX)
-  strand_rows = rows_of(strand_r, keep)
-  ccs_rows = rows_of(ccs_r)
-  sn_rows = np.clip(rows_of(sn_r), 0, params.SN_MAX)
-  if params.use_ccs_bq:
-    features = [base_rows, pw_rows, ip_rows, strand_rows, ccs_rows,
-                rows_of(ccs_bq_r), sn_rows]
-  else:
-    features = [base_rows, pw_rows, ip_rows, strand_rows, ccs_rows, sn_rows]
-  rows = np.concatenate(features, axis=0)
-  assert rows.shape == (params.total_rows, params.max_length, 1), rows.shape
-  return rows
+  return format_rows_batch(subreads[None], params)[0]
 
 
 def format_rows_batch(

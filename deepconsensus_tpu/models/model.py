@@ -103,7 +103,7 @@ class BandedSelfAttention(nn.Module):
       from deepconsensus_tpu.ops import banded_attention as ba
       from deepconsensus_tpu.ops import flash_band_attention as fba
 
-      if deterministic and x.shape[1] > 128:
+      if deterministic and x.shape[1] > fba.WHOLE_L_LIMIT:
         # Long windows: the whole-L kernel's [G, L, L] VMEM block no
         # longer fits (and stops compiling past L~256); the
         # block-banded flash kernel scales as L*band instead

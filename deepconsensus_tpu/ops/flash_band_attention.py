@@ -35,6 +35,11 @@ Array = jnp.ndarray
 
 _NEG = -1e9
 
+# Above this window length the whole-L kernel (banded_attention.py)
+# stops being the right tool — its [G, L, L] VMEM block grows past
+# what fits/compiles — and callers should switch to this kernel.
+WHOLE_L_LIMIT = 128
+
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             attn_win_size, length, block_q, block_k, n_kblocks,

@@ -35,6 +35,22 @@ from deepconsensus_tpu.preprocess.alignment import AlignedRead
 Cigar = constants.Cigar
 
 
+def _ins_col_mask(
+    maxins: np.ndarray, block_start: np.ndarray, total_cols: int
+) -> np.ndarray:
+  """Boolean mask of insertion columns from the per-boundary widths."""
+  is_ins_col = np.zeros(total_cols, dtype=bool)
+  nz = np.flatnonzero(maxins)
+  if nz.size:
+    starts = block_start[nz]
+    widths = maxins[nz]
+    offsets = np.arange(int(widths.sum()))
+    group_starts = np.repeat(np.cumsum(widths) - widths, widths)
+    ins_cols = np.repeat(starts, widths) + (offsets - group_starts)
+    is_ins_col[ins_cols[ins_cols < total_cols]] = True
+  return is_ins_col
+
+
 def _column_layout_batched(
     nonlabel: List[AlignedRead],
 ) -> Tuple[List[np.ndarray], np.ndarray, int]:
@@ -103,17 +119,8 @@ def _column_layout_batched(
   cols_per_read = [
       cols[ends[i] - lens[i] : ends[i]] for i in range(n_reads)
   ]
-
-  is_ins_col = np.zeros(total_cols, dtype=bool)
-  nz = np.flatnonzero(maxins)
-  if nz.size:
-    starts = block_start[nz]
-    widths = maxins[nz]
-    offsets = np.arange(int(widths.sum()))
-    group_starts = np.repeat(np.cumsum(widths) - widths, widths)
-    ins_cols = np.repeat(starts, widths) + (offsets - group_starts)
-    is_ins_col[ins_cols[ins_cols < total_cols]] = True
-  return cols_per_read, is_ins_col, total_cols
+  return cols_per_read, _ins_col_mask(maxins, block_start,
+                                      total_cols), total_cols
 
 
 def _column_layout(
@@ -174,17 +181,8 @@ def _column_layout(
     if n:
       total_cols = max(total_cols, int(cols[-1]) + 1)
 
-  # Mark which columns are insertion columns.
-  is_ins_col = np.zeros(total_cols, dtype=bool)
-  nz = np.flatnonzero(maxins)
-  if nz.size:
-    starts = block_start[nz]
-    widths = maxins[nz]
-    offsets = np.arange(int(widths.sum()))
-    group_starts = np.repeat(np.cumsum(widths) - widths, widths)
-    ins_cols = np.repeat(starts, widths) + (offsets - group_starts)
-    is_ins_col[ins_cols[ins_cols < total_cols]] = True
-  return cols_per_read, is_ins_col, total_cols
+  return cols_per_read, _ins_col_mask(maxins, block_start,
+                                      total_cols), total_cols
 
 
 def _label_layout(
