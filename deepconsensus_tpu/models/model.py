@@ -103,12 +103,16 @@ class BandedSelfAttention(nn.Module):
       from deepconsensus_tpu.ops import banded_attention as ba
       from deepconsensus_tpu.ops import flash_band_attention as fba
 
-      if deterministic and x.shape[1] > fba.WHOLE_L_LIMIT:
+      if (deterministic or self.dropout_rate == 0.0
+          ) and x.shape[1] > fba.WHOLE_L_LIMIT:
         # Long windows: the whole-L kernel's [G, L, L] VMEM block no
         # longer fits (and stops compiling past L~256); the
         # block-banded flash kernel scales as L*band instead
-        # (measured 1.1-3.2x the XLA path at L=256..4096 on v5e).
-        out = fba.flash_band_attention(
+        # (measured 1.1-3.2x the XLA path at L=256..4096 on v5e) and
+        # trains through its own custom VJP. Long-window training
+        # with attention dropout falls through to the whole-L dropout
+        # kernel (unsupported past its VMEM limit — use the XLA path).
+        out = fba.flash_band_attention_vjp(
             query, key, value, self.attn_win_size or None
         )
       elif deterministic or self.dropout_rate == 0.0:

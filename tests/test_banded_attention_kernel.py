@@ -216,3 +216,37 @@ def test_flash_kernel_in_long_window_model():
   np.testing.assert_allclose(
       np.asarray(flash), np.asarray(base), atol=1e-5
   )
+
+
+@pytest.mark.parametrize('l,win', [
+    (100, 12),
+    (256, 12),
+    (257, 30),
+    (192, None),
+])
+def test_flash_band_vjp_grads_match_reference(l, win):
+  """The flash-band custom VJP (lse-saving forward + two backward
+  kernels) must match jax.grad through the unfused reference."""
+  import jax
+  from deepconsensus_tpu.ops import flash_band_attention as fba
+
+  q, k, v = make_qkv(b=1, l=l, h=2, d=32, seed=11)
+
+  def ref_loss(q, k, v):
+    out = ba.reference_banded_attention(q, k, v, win)
+    return jnp.sum(out * jnp.cos(out))
+
+  def flash_loss(q, k, v):
+    out = fba.flash_band_attention_vjp(q, k, v, win, True)
+    return jnp.sum(out * jnp.cos(out))
+
+  np.testing.assert_allclose(
+      np.asarray(flash_loss(q, k, v)), np.asarray(ref_loss(q, k, v)),
+      rtol=1e-5,
+  )
+  want = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+  got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+  for g, w in zip(got, want):
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(w), atol=3e-4, rtol=1e-4
+    )
