@@ -210,15 +210,12 @@ def flash_band_attention(
     v: Array,
     attn_win_size: Optional[int],
     interpret: Optional[bool] = None,
-    block_q: int = 128,
-    group: int = 8,
 ) -> Array:
   """Banded flash attention. q,k,v: [B, L, H, D], q pre-scaled.
 
   attn_win_size None means full (unbanded) attention; the key-block
   loop then covers the whole sequence.
   """
-  del block_q, group  # geometry fixed by _Plan defaults
   return _forward(q, k, v, attn_win_size, interpret, emit_lse=False)
 
 
