@@ -302,6 +302,43 @@ def main():
       details['stages'][name] = {'error': repr(e)[:200]}
       _write_details(details)
 
+  # Stage 6 (first to drop on budget): long-window flash-band attention vs XLA (bare kernels,
+  # L=1024 — the regime the whole-L kernel cannot compile for).
+  if budget_left() > 90:
+    try:
+      from deepconsensus_tpu.ops import banded_attention as ba_lib
+      from deepconsensus_tpu.ops import flash_band_attention as fba_lib
+
+      rng = np.random.default_rng(3)
+      bq = 128
+      mk = lambda: jnp.asarray(
+          rng.normal(size=(bq, 1024, 2, 140)).astype(np.float32)
+      ).astype(jnp.bfloat16)
+      q, k, v = mk(), mk(), mk()
+
+      def timed(fn):
+        out = fn(q, k, v)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        for i in range(10):
+          out = fn(q.at[0, 0, 0, 0].set(float(i)), k, v)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / 10
+
+      t_xla = timed(jax.jit(
+          lambda q, k, v: ba_lib.reference_banded_attention(q, k, v, 12)))
+      t_flash = timed(jax.jit(
+          lambda q, k, v: fba_lib.flash_band_attention(q, k, v, 12)))
+      details['stages']['attn_L1024_flash_vs_xla'] = {
+          'xla_us': round(t_xla * 1e6, 1),
+          'flash_us': round(t_flash * 1e6, 1),
+          'flash_speedup': round(t_xla / t_flash, 3),
+      }
+      _write_details(details)
+    except Exception as e:
+      details['stages']['attn_L1024_flash_vs_xla'] = {'error': repr(e)[:200]}
+      _write_details(details)
+
   scan = details['stages'].get('train_b256_scan', {})
   pal = details['stages'].get('train_b256_pallas_vjp', {})
   if 'examples_per_sec' in scan and 'examples_per_sec' in pal:
