@@ -116,6 +116,10 @@ class Pileup:
 
   _width: Optional[int] = None
   _ccs_width: Optional[int] = None
+  # Window pileups yielded by iter_windows carry their feature tensor
+  # pre-sliced from the parent ZMW matrix (label rows are not part of
+  # the matrix, so training label adjustments don't invalidate it).
+  _cached_features: Optional[np.ndarray] = None
 
   @property
   def is_training(self) -> bool:
@@ -215,16 +219,31 @@ class Pileup:
 
   def iter_windows(self) -> Iterator['Pileup']:
     """Yields fixed-width window Pileups (reference iter_examples:
-    pre_lib.py:652-697)."""
+    pre_lib.py:652-697). Each yielded window carries its feature
+    tensor pre-sliced from the ZMW matrix (built once), so
+    to_example/extract_features skip the per-window re-stacking."""
     self.counter = Counter()
-    max_length = self.layout.max_length
+    layout = self.layout
+    max_length = layout.max_length
+    matrix = self.full_matrix()
+    keep = self.subreads[: layout.max_passes]
+    strand_rows = layout.indices('strand', self.n_subreads)
+    sn_rows = layout.indices('sn')
+    strand_col = np.array(
+        [float(int(r.strand)) for r in keep], dtype=constants.NP_DATA_TYPE
+    )
+    sn_col = (
+        np.asarray(self.subreads[0].sn, dtype=constants.NP_DATA_TYPE)
+        if self.subreads else np.zeros(4, dtype=constants.NP_DATA_TYPE)
+    )
+
     start = 0
     for window_width in self.calculate_windows(max_length):
       self.counter[f'example_width_bucket_{window_width}'] += 1
       window = self.window_slice(slice(start, start + window_width))
       if start > self.ccs_width:
         break
-      start += window_width
+      win_start, start = start, start + window_width
       if window.is_empty:
         self.counter['n_examples_no_ccs_idx'] += 1
         continue
@@ -246,7 +265,24 @@ class Pileup:
         self.counter['n_examples_skip_large_windows_keep'] += 1
 
       reads = [x.pad(max_length) for x in window.reads]
-      yield Pileup(self.name, reads, self.layout, overflow=overflow)
+      out = Pileup(self.name, reads, self.layout, overflow=overflow)
+      # Same tail padding rules as AlignedRead.pad: strand/sn repeat,
+      # ccs_bq pads with -1, everything else pads with zeros.
+      width = max(window_width, max_length)
+      chunk = matrix[:, win_start : win_start + window_width]
+      if chunk.shape[1] < width:
+        data = np.zeros(
+            (layout.tensor_height, width), dtype=constants.NP_DATA_TYPE
+        )
+        data[:, : chunk.shape[1]] = chunk
+        data[strand_rows, chunk.shape[1]:] = strand_col[:, None]
+        data[sn_rows, chunk.shape[1]:] = sn_col[:, None]
+        if layout.use_ccs_bq:
+          data[layout.indices('ccs_bq'), chunk.shape[1]:] = -1
+      else:
+        data = chunk
+      out._cached_features = data[:, :, None]
+      yield out
 
   # ------------------------------------------------------------------
   def extract_features(self, min_width: int = 0) -> np.ndarray:
@@ -254,6 +290,8 @@ class Pileup:
     (reference: pre_lib.py:704-744). min_width over-allocates columns
     (zero-filled past the pileup) so the batched window path can
     reshape in place instead of re-copying into a padded buffer."""
+    if self._cached_features is not None and not min_width:
+      return self._cached_features
     layout = self.layout
     n_subreads = self.n_subreads
     data = np.zeros(
