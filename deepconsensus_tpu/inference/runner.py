@@ -93,14 +93,45 @@ class InferenceOptions:
 _SN_ROWS = 4  # trailing rows: per-window SN constants (layout: pileup.py)
 
 
-def _assemble_rows(main_u8: jnp.ndarray, sn: jnp.ndarray) -> jnp.ndarray:
+def _assemble_rows(main_u8: jnp.ndarray, sn: jnp.ndarray,
+                   bq_row: Optional[int] = None) -> jnp.ndarray:
   """Device-side inverse of dispatch()'s compact split: uint8 rows ->
-  f32, SN scalars re-broadcast across the window."""
+  f32, SN scalars re-broadcast across the window.
+
+  bq_row: index of the ccs_bq row inside main_u8, if the model uses
+  one. That row travels biased by +1 (its spaced values include -1
+  sentinels at gap columns / padded tails, which a plain uint8 cast
+  would wrap to 255); undo the bias here.
+  """
   b, _, l, _ = main_u8.shape
+  main = main_u8.astype(jnp.float32)
+  if bq_row is not None:
+    main = main.at[:, bq_row].add(-1.0)
   sn_rows = jnp.broadcast_to(
       sn.astype(jnp.float32)[:, :, None, None], (b, _SN_ROWS, l, 1)
   )
-  return jnp.concatenate([main_u8.astype(jnp.float32), sn_rows], axis=1)
+  return jnp.concatenate([main, sn_rows], axis=1)
+
+
+def _bq_row_index(params) -> Optional[int]:
+  """Row index of the ccs_bq row within the non-SN block, taken from
+  the canonical layout (pileup.row_indices) rather than re-derived.
+
+  Also guards the compact-transport assumption: every non-SN row must
+  fit 0..255 after the ccs_bq +1 bias, and PW_MAX/IP_MAX are
+  config-tunable, so fail loudly instead of silently truncating.
+  """
+  from deepconsensus_tpu.preprocess import pileup
+
+  if params.PW_MAX > 255 or params.IP_MAX > 255:
+    raise ValueError(
+        f'compact uint8 dispatch requires PW_MAX/IP_MAX <= 255, got '
+        f'{params.PW_MAX}/{params.IP_MAX}'
+    )
+  if not params.use_ccs_bq:
+    return None
+  bq_lo, _bq_hi = pileup.row_indices(params.max_passes, True)[5]
+  return bq_lo
 
 
 class ModelRunner:
@@ -141,9 +172,11 @@ class ModelRunner:
             for key, value in variables.items()
         }
     model = model_lib.get_model(params)
+    self._bq_row = _bq_row_index(params)
+    bq_row = self._bq_row
 
     def forward(variables, main_u8, sn):
-      rows = _assemble_rows(main_u8, sn)
+      rows = _assemble_rows(main_u8, sn, bq_row)
       preds = model.apply(variables, rows)
       pred_ids = jnp.argmax(preds, axis=-1).astype(jnp.int32)
       max_prob = jnp.max(preds, axis=-1)
@@ -209,10 +242,12 @@ class ModelRunner:
     runner.variables = None
     options.batch_size = int(meta['batch_size'])
     runner.options = options
+    runner._bq_row = _bq_row_index(params)
+    bq_row = runner._bq_row
 
     @jax.jit
     def forward(_variables, main_u8, sn):
-      preds = serving(_assemble_rows(main_u8, sn))
+      preds = serving(_assemble_rows(main_u8, sn, bq_row))
       return (
           jnp.argmax(preds, axis=-1).astype(jnp.int32),
           jnp.max(preds, axis=-1),
@@ -229,16 +264,23 @@ class ModelRunner:
 
     Transfer is compact: every non-SN row holds clip-bounded integers
     (bases/ccs 0-4, pw/ip <= PW_MAX/IP_MAX = 255, strand 0-2, ccs_bq
-    <= 93), and the 4 SN rows are per-window constants, so the batch
-    ships as uint8 rows + [B, 4] float SN scalars (~4x less than f32
-    rows over PCIe/tunnel) and reassembles losslessly on device.
+    -1..93 shipped biased by +1), and the 4 SN rows are per-window
+    constants, so the batch ships as uint8 rows + [B, 4] float SN
+    scalars (~4x less than f32 rows over PCIe/tunnel) and reassembles
+    losslessly on device (_assemble_rows undoes the ccs_bq bias).
     """
     n = rows.shape[0]
     batch = self.options.batch_size
     if n < batch:
       pad = np.zeros((batch - n,) + rows.shape[1:], rows.dtype)
       rows = np.concatenate([rows, pad])
-    main_u8 = rows[:, :-_SN_ROWS].astype(np.uint8)
+    main = rows[:, :-_SN_ROWS]
+    main_u8 = main.astype(np.uint8)
+    if self._bq_row is not None:
+      # Spaced ccs_bq holds -1 sentinels; bias to 0..94 so the uint8
+      # cast is lossless (the device side subtracts 1 back).
+      main_u8[:, self._bq_row] = (main[:, self._bq_row] + 1.0).astype(
+          np.uint8)
     sn = np.ascontiguousarray(rows[:, -_SN_ROWS:, 0, 0].astype(np.float32))
     pred_ids, max_prob = self._forward(
         self.variables, jnp.asarray(main_u8), jnp.asarray(sn)

@@ -96,26 +96,33 @@ class BandedSelfAttention(nn.Module):
     key = dense('key')(x)
     value = dense('value')(x)
 
-    if self.use_pallas:
+    use_dropout = not deterministic and self.dropout_rate > 0.0
+    use_pallas = self.use_pallas
+    long_window = False
+    if use_pallas:
       # Fused VMEM kernel with custom VJP, so it serves training too.
       # Dropout uses a caller-generated bernoulli keep-mask shared by
       # forward and backward (ops/banded_attention.py).
       from deepconsensus_tpu.ops import banded_attention as ba
       from deepconsensus_tpu.ops import flash_band_attention as fba
 
-      if (deterministic or self.dropout_rate == 0.0
-          ) and x.shape[1] > fba.WHOLE_L_LIMIT:
+      long_window = x.shape[1] > fba.WHOLE_L_LIMIT
+      if use_dropout and long_window:
+        # The whole-L dropout kernel stops compiling past its VMEM
+        # limit and would materialize a [B, N, L, L] bernoulli mask;
+        # long-window training with attention dropout routes to the
+        # XLA path below instead (the flash kernel has no dropout).
+        use_pallas = False
+    if use_pallas:
+      if long_window:
         # Long windows: the whole-L kernel's [G, L, L] VMEM block no
-        # longer fits (and stops compiling past L~256); the
-        # block-banded flash kernel scales as L*band instead
-        # (measured 1.1-3.2x the XLA path at L=256..4096 on v5e) and
-        # trains through its own custom VJP. Long-window training
-        # with attention dropout falls through to the whole-L dropout
-        # kernel (unsupported past its VMEM limit — use the XLA path).
+        # longer fits; the block-banded flash kernel scales as L*band
+        # instead (measured 1.1-3.2x the XLA path at L=256..4096 on
+        # v5e) and trains through its own custom VJP.
         out = fba.flash_band_attention_vjp(
             query, key, value, self.attn_win_size or None
         )
-      elif deterministic or self.dropout_rate == 0.0:
+      elif not use_dropout:
         out = ba.banded_attention_vjp(
             query, key, value, self.attn_win_size or None
         )

@@ -399,7 +399,11 @@ def _vjp_bwd(attn_win_size, interpret, res, do):
   dob2 = plan.to_blocks(do, q_pad_lo, q_hi)
   kb2 = plan.to_blocks(k, 0, lq - l)
   vb2 = plan.to_blocks(v, 0, lq - l)
-  pad2 = ((0, 0), (q_pad_lo, 0))
+  # Mirror qb2/dob2's two-sided padding so every (g, i+j) block index
+  # is in range: lse_b/delta_b are already lq wide (high-padded by
+  # lq-l), so add q_pad_lo on both sides rather than relying on
+  # Pallas' OOB block clamping for the trailing masked tiles.
+  pad2 = ((0, 0), (q_pad_lo, q_pad_lo))
   lse2 = jnp.pad(lse_b, pad2)
   delta2 = jnp.pad(delta_b, pad2)
 

@@ -74,7 +74,10 @@ def _column_layout_batched(
 
   # boundary of each position = #non-insertions before it IN ITS READ.
   cs = np.cumsum(nonins)
-  cs_end = cs[ends - 1]
+  # Exclusive-prefix indexing so zero-length reads (ends[i] == start[i])
+  # don't wrap: cs[ends - 1] would read cs[-1] for a leading empty read.
+  cs_pad = np.concatenate([[0], cs])
+  cs_end = cs_pad[ends]
   cs_before = np.concatenate([[0], cs_end[:-1]])
   boundary = cs - cs_before[read_idx] - nonins
   nonins_per_read = cs_end - cs_before

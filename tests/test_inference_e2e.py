@@ -98,6 +98,43 @@ def test_skip_windows_adopt_ccs(testdata_dir, tmp_path, small_runner):
     assert len(seq) == len(qual)
 
 
+def test_compact_dispatch_lossless_with_ccs_bq():
+  """Compact uint8 transport must preserve ccs_bq -1 sentinels (gap
+  columns / padded tails) instead of wrapping them to 255 (ADVICE r2)."""
+  params = config_lib.get_config('transformer_learn_values+test_bq')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = 1
+    params.filter_size = 64
+  model = model_lib.get_model(params)
+  mp, n_rows, length = params.max_passes, params.total_rows, params.max_length
+  rng = np.random.default_rng(0)
+  batch = 8
+  rows = np.zeros((batch, n_rows, length, 1), np.float32)
+  rows[:, :mp] = rng.integers(0, 5, (batch, mp, length, 1))
+  rows[:, mp:2 * mp] = rng.integers(0, 256, (batch, mp, length, 1))
+  rows[:, 2 * mp:3 * mp] = rng.integers(0, 256, (batch, mp, length, 1))
+  rows[:, 3 * mp:4 * mp] = rng.integers(0, 3, (batch, mp, length, 1))
+  rows[:, 4 * mp] = rng.integers(0, 5, (batch, length, 1))
+  bq = rng.integers(-1, 94, (batch, length, 1)).astype(np.float32)
+  bq[:, length // 2:] = -1.0  # padded-tail sentinels
+  rows[:, 4 * mp + 1] = bq
+  rows[:, -4:] = rng.uniform(0, 20, (batch, 4, 1, 1)).astype(np.float32)
+  variables = model.init(
+      jax.random.PRNGKey(0), jnp.zeros((1, n_rows, length, 1)))
+  options = runner_lib.InferenceOptions(batch_size=batch)
+  runner = runner_lib.ModelRunner(params, variables, options)
+
+  pred_ids, max_prob, n = runner.dispatch(rows)
+  direct = model.apply(variables, jnp.asarray(rows))
+  np.testing.assert_array_equal(
+      np.asarray(pred_ids[:n]), np.asarray(jnp.argmax(direct, axis=-1)))
+  np.testing.assert_allclose(
+      np.asarray(max_prob[:n]), np.asarray(jnp.max(direct, axis=-1)),
+      rtol=1e-5)
+
+
 def test_preprocess_driver_matches_feeder(testdata_dir, tmp_path):
   from deepconsensus_tpu.preprocess.driver import run_preprocess
   from deepconsensus_tpu.io import tfrecord

@@ -181,3 +181,40 @@ def test_batched_column_layout_equals_per_read(seed):
   np.testing.assert_array_equal(got_ins, want_ins)
   for g, w in zip(got_cols, want_cols):
     np.testing.assert_array_equal(g, w)
+
+
+def _empty_read(name='m/1/e'):
+  return AlignedRead(
+      name=name,
+      bases=np.zeros(0, np.uint8),
+      cigar=np.zeros(0, np.uint8),
+      pw=np.zeros(0, np.int32),
+      ip=np.zeros(0, np.int32),
+      sn=np.ones(4, np.float32),
+      strand=constants.Strand.FORWARD,
+      ccs_idx=np.zeros(0, np.int64),
+  )
+
+
+@pytest.mark.parametrize('empty_at', [0, 1, 'last', 'all'])
+def test_batched_column_layout_handles_empty_reads(empty_at):
+  """Zero-length reads must not corrupt the cumsum segmentation: a
+  leading empty read made cs[ends-1] wrap to cs[-1], shifting every
+  later read's columns negative (ADVICE r2)."""
+  from deepconsensus_tpu.preprocess import spacing
+
+  rng = np.random.default_rng(11)
+  reads = [random_read(rng, 12, name=f'm/1/{i}') for i in range(3)]
+  if empty_at == 'all':
+    reads = [_empty_read(f'm/1/e{i}') for i in range(2)]
+  elif empty_at == 'last':
+    reads.append(_empty_read())
+  else:
+    reads.insert(empty_at, _empty_read())
+  want_cols, want_ins, want_total = spacing._column_layout(reads)
+  got_cols, got_ins, got_total = spacing._column_layout_batched(reads)
+  assert got_total == want_total
+  np.testing.assert_array_equal(got_ins, want_ins)
+  for g, w in zip(got_cols, want_cols):
+    np.testing.assert_array_equal(g, w)
+    assert (g >= 0).all()
