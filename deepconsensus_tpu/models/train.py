@@ -575,6 +575,7 @@ def run_training(
           batch_size=params.batch_size,
           **({'buffer_size': params.buffer_size}
              if 'buffer_size' in params else {}),
+          workers=params.get('loader_workers', 0),
           seed=params.seed + step,
       )
       it = iter(ds)

@@ -39,9 +39,24 @@ def test_bam_output_mode(tmp_path, testdata_dir, runner_and_options):
       options=options,
       runner=runner,
   )
-  records = list(bam_lib.BamReader(out))
+  out_reader = bam_lib.BamReader(out)
+  ccs_reader = bam_lib.BamReader(
+      str(testdata_dir / 'human_1m/ccs.bam'))
+  # The CCS header (incl. its @RG lines) must carry into the output so
+  # per-read RG:Z tags reference declared read groups
+  # (reference quick_inference.py:894-897 uses template=ccs).
+  assert ccs_reader.header_text.strip()
+  assert ccs_reader.header_text in out_reader.header_text
+  declared_rgs = {
+      line.split('ID:')[1].split('\t')[0]
+      for line in ccs_reader.header_text.splitlines()
+      if line.startswith('@RG') and 'ID:' in line
+  }
+  records = list(out_reader)
   assert len(records) == counters['success'] > 0
   for rec in records:
+    if rec.has_tag('RG'):
+      assert rec.get_tag('RG') in declared_rgs
     assert rec.is_unmapped
     assert rec.qname.endswith('/ccs')
     assert rec.get_tag('zm') == int(rec.qname.split('/')[1])

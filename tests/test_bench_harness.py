@@ -1,4 +1,4 @@
-"""bench.py supervision: result-line extraction and failure reporting."""
+"""bench.py supervision: metric-line detection and failure reporting."""
 import json
 import sys
 
@@ -7,21 +7,17 @@ sys.path.insert(0, '/root/repo')
 import bench  # noqa: E402
 
 
-def test_find_result_line_picks_metric_json():
-  stdout = '\n'.join([
-      'WARNING: some backend log',
-      json.dumps({'metric': 'model_forward_windows_per_sec',
-                  'value': 123.0, 'unit': 'w/s', 'vs_baseline': 1.1}),
-      'I0000 shutdown notice',
-  ])
-  line = bench._find_result_line(stdout)
-  assert line is not None
-  assert json.loads(line)['value'] == 123.0
+def test_is_metric_line_accepts_metric_json():
+  line = json.dumps({'metric': 'model_forward_windows_per_sec',
+                     'value': 123.0, 'unit': 'w/s', 'vs_baseline': 1.1})
+  assert bench._is_metric_line(line)
 
 
-def test_find_result_line_none_for_garbage():
-  assert bench._find_result_line('no json here\n{"not_metric": 1}') is None
-  assert bench._find_result_line('') is None
+def test_is_metric_line_rejects_garbage():
+  assert not bench._is_metric_line('no json here')
+  assert not bench._is_metric_line('{"not_metric": 1}')
+  assert not bench._is_metric_line('')
+  assert not bench._is_metric_line('WARNING: some backend log')
 
 
 def test_report_failure_schema(capsys):
@@ -32,3 +28,11 @@ def test_report_failure_schema(capsys):
   assert out['value'] == 0.0
   assert 'unit test' in out['unit']
   assert out['vs_baseline'] == 0.0
+
+
+def test_forward_line_units_are_honest():
+  line = bench._forward_line(228.0, 256, cpu_fallback=False)
+  assert line['vs_baseline'] == 2.0
+  assert 'NOT forward-to-forward' in line['unit']
+  cpu = bench._forward_line(40.0, 256, cpu_fallback=True)
+  assert 'CPU FALLBACK' in cpu['unit']
