@@ -158,7 +158,10 @@ def _add_export(sub):
                  help='Orbax checkpoint directory (with params.json).')
   p.add_argument('--output', required=True, help='Output directory.')
   p.add_argument('--batch_size', type=int, default=1024,
-                 help='Fixed serving batch size baked into the export.')
+                 help='Recommended serving batch size recorded in the '
+                 'artifact metadata. The export is batch-polymorphic '
+                 '(serves any batch size) unless symbolic export fails, '
+                 'in which case this size is baked in.')
 
 
 def _add_distill(sub):

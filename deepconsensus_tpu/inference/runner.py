@@ -248,7 +248,9 @@ class ModelRunner:
     runner = cls.__new__(cls)
     runner.params = params
     runner.variables = None
-    options.batch_size = int(meta['batch_size'])
+    if not meta.get('polymorphic_batch'):
+      # Fixed-batch artifact: the compiled shape wins over the flag.
+      options.batch_size = int(meta['batch_size'])
     runner.options = options
     runner._bq_row = _bq_row_index(params)
     bq_row = runner._bq_row

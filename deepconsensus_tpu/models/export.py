@@ -9,6 +9,7 @@ artifact reloads without any model code, like a SavedModel signature.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Callable, Dict, Optional, Tuple
 
@@ -29,13 +30,20 @@ def export_model(
     batch_size: int = 1024,
     variables: Optional[Dict] = None,
     params: Optional[ml_collections.ConfigDict] = None,
+    polymorphic_batch: bool = True,
 ) -> str:
-  """Exports a serving function rows->softmax; returns artifact path."""
+  """Exports a serving function rows->softmax; returns artifact path.
+
+  polymorphic_batch exports the batch dimension symbolically, so the
+  artifact serves ANY batch size (the reference's SavedModel does
+  this; a fixed-batch artifact was the round-2 limitation).
+  batch_size is kept in the metadata as the recommended serving batch.
+  Falls back to a fixed-batch export if symbolic export fails.
+  """
   if params is None:
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
   model = model_lib.get_model(params)
-  rows_shape = (batch_size, params.total_rows, params.max_length, 1)
 
   if variables is None:
     from deepconsensus_tpu.models.checkpoints import load_params
@@ -45,16 +53,36 @@ def export_model(
   def serving_fn(rows):
     return model.apply(variables, rows)
 
-  exported = jax_export.export(jax.jit(serving_fn))(
-      jax.ShapeDtypeStruct(rows_shape, jnp.float32)
-  )
+  static_shape = (batch_size, params.total_rows, params.max_length, 1)
+  exported = None
+  is_polymorphic = False
+  if polymorphic_batch:
+    try:
+      (b,) = jax_export.symbolic_shape('b')
+      exported = jax_export.export(jax.jit(serving_fn))(
+          jax.ShapeDtypeStruct(
+              (b,) + static_shape[1:], jnp.float32
+          )
+      )
+      is_polymorphic = True
+    except Exception as e:  # pragma: no cover - model not batch-polymorphic
+      logging.warning(
+          'Batch-polymorphic export failed (%s: %s); falling back to a '
+          'fixed-batch artifact that only serves batch_size=%d.',
+          type(e).__name__, e, batch_size)
+      exported = None
+  if exported is None:
+    exported = jax_export.export(jax.jit(serving_fn))(
+        jax.ShapeDtypeStruct(static_shape, jnp.float32)
+    )
   os.makedirs(out_dir, exist_ok=True)
   artifact = os.path.join(out_dir, ARTIFACT_NAME)
   with open(artifact, 'wb') as f:
     f.write(exported.serialize())
   config_lib.save_params_as_json(out_dir, params)
   with open(os.path.join(out_dir, 'export_meta.json'), 'w') as f:
-    json.dump({'batch_size': batch_size, 'rows_shape': rows_shape}, f)
+    json.dump({'batch_size': batch_size, 'rows_shape': static_shape,
+               'polymorphic_batch': is_polymorphic}, f)
   return artifact
 
 

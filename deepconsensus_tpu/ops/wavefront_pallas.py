@@ -35,6 +35,34 @@ from deepconsensus_tpu.ops import wavefront
 
 Array = jnp.ndarray
 
+# Max diagonals computed per grid step in the forward kernel. Each
+# diagonal's vector work ([B, m+1]) is tiny next to a grid step's fixed
+# overhead, so unrolling amortizes the ~m+n sequential steps that
+# dominate this DP's runtime. VMEM cost grows linearly with unroll
+# (Pallas double-buffers the streamed [unroll, B, m]/[B, m+1] blocks,
+# and emit_rows streams an [unroll, B, m+1] output block too), so the
+# effective unroll is capped per call by _auto_unroll to keep streamed
+# blocks inside a VMEM budget. Override the max via
+# DC_TPU_PALLAS_UNROLL (1 disables unrolling).
+import os as _os
+
+PALLAS_UNROLL = int(_os.environ.get('DC_TPU_PALLAS_UNROLL', '8'))
+
+# Streamed-block VMEM budget (bytes). ~16 MB/core total; leave room
+# for the three [B, m+1] scratch rows and the non-streamed operands.
+_VMEM_STREAM_BUDGET = 8 * 1024 * 1024
+
+
+def _auto_unroll(requested, batch, m, emit_rows):
+  """Largest unroll <= requested whose double-buffered streamed blocks
+  (subs [u,B,m] + ins [u,B,m+1], plus rows [u,B,m+1] when emit_rows)
+  fit in _VMEM_STREAM_BUDGET."""
+  per_diag = 2 * 4 * batch * (2 * m + 1)
+  if emit_rows:
+    per_diag += 2 * 4 * batch * (m + 1)
+  fit = max(1, _VMEM_STREAM_BUDGET // max(per_diag, 1))
+  return max(1, min(requested, fit))
+
 
 def _make_minop(loss_reg):
   if loss_reg is None:
@@ -93,16 +121,19 @@ def _recompute_band(k, rows_p2, rows_p1, subs_k, ins_k, del_cost,
 
 def _fwd_kernel(subs_ref, ins_ref, ins0_ref, lens_ref, out_ref, rows_ref,
                 v_p2_ref, v_p1_ref, v_opt_ref, *, m, n, del_cost,
-                loss_reg, inf, emit_rows):
-  """Grid step g computes diagonal k = g + 2.
+                loss_reg, inf, unroll):
+  """Grid step g computes diagonals k = g*unroll + u + 2, u = 0..unroll-1.
 
-  Streams subs[k-2] ([1, B, m]) and ins[k-1] ([1, B, m+1]); carries
-  V[k-2], V[k-1] in VMEM scratch. With emit_rows, every V[k] is also
-  streamed back to HBM for the backward sweep.
+  Streams subs[k-2] and ins[k-1] in blocks of `unroll` diagonals;
+  carries V[k-2], V[k-1] in VMEM scratch across grid steps. The
+  per-diagonal vector work ([B, m+1]) is far smaller than a grid
+  step's fixed cost, so unrolling several diagonals per step amortizes
+  the sequential-grid overhead that dominates this DP. Diagonals past
+  m+n (grid padding) are masked invalid by the k-range check inside
+  _dp_step. With emit_rows (rows_ref not None), every V[k] streams
+  back to HBM for the backward sweep.
   """
-  del emit_rows
   g = pl.program_id(0)
-  k = g + 2
   b = v_p1_ref.shape[0]
   i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
   minop = _make_minop(loss_reg)
@@ -119,31 +150,56 @@ def _fwd_kernel(subs_ref, ins_ref, ins0_ref, lens_ref, out_ref, rows_ref,
     v_p1_ref[:] = row1
     v_opt_ref[:] = jnp.full((b, 1), inf, jnp.float32)
 
-  v_p2_next, v_new = _dp_step(
-      k, v_p2_ref[:][:, :m], v_p1_ref[:], subs_ref[0], ins_ref[0],
-      i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
-  )
-  if rows_ref is not None:
-    rows_ref[0] = v_new
-  v_at_len = jnp.sum(v_new * onehot_len, axis=1, keepdims=True)
-  hit = (k_end == k)[:, None].astype(jnp.float32)
-  v_opt_ref[:] = v_opt_ref[:] * (1.0 - hit) + v_at_len * hit
-  v_p2_ref[:] = jnp.concatenate(
-      [v_p2_next, jnp.full((b, 1), inf, jnp.float32)], axis=1
-  )
-  v_p1_ref[:] = v_new
-  out_ref[:] = v_opt_ref[:]
+  v_p2 = v_p2_ref[:]
+  v_p1 = v_p1_ref[:]
+  v_opt = v_opt_ref[:]
+  for u in range(unroll):
+    k = g * unroll + u + 2
+    v_p2_next, v_new = _dp_step(
+        k, v_p2[:, :m], v_p1, subs_ref[u], ins_ref[u],
+        i_range=i_range, n=n, del_cost=del_cost, minop=minop, inf=inf,
+    )
+    if rows_ref is not None:
+      rows_ref[u] = v_new
+    v_at_len = jnp.sum(v_new * onehot_len, axis=1, keepdims=True)
+    hit = (k_end == k)[:, None].astype(jnp.float32)
+    v_opt = v_opt * (1.0 - hit) + v_at_len * hit
+    v_p2 = jnp.concatenate(
+        [v_p2_next, jnp.full((b, 1), inf, jnp.float32)], axis=1
+    )
+    v_p1 = v_new
+  v_p2_ref[:] = v_p2
+  v_p1_ref[:] = v_p1
+  v_opt_ref[:] = v_opt
+  out_ref[:] = v_opt
+
+
+def _pad_diagonals(t, n_pad):
+  """Zero-pads a [K, ...]-leading diagonal stream to n_pad entries."""
+  k_dim = t.shape[0]
+  if k_dim == n_pad:
+    return t
+  pad_widths = [(0, n_pad - k_dim)] + [(0, 0)] * (t.ndim - 1)
+  return jnp.pad(t, pad_widths)
 
 
 def _fwd_call(subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
-              interpret, emit_rows):
+              interpret, emit_rows, unroll):
   k_dim = subs_w.shape[0]  # m + n - 1
   batch = subs_w.shape[1]
+  unroll = _auto_unroll(unroll, batch, m, emit_rows)
+  unroll = max(1, min(unroll, k_dim))
+  n_blocks = -(-k_dim // unroll)
+  n_pad = n_blocks * unroll
   ins0 = ins_w[0]  # [B, m+1]
+  subs_pad = _pad_diagonals(subs_w, n_pad)
+  # ins diagonal for k lives at ins_w[k-1]; shift so entry j serves
+  # k = j + 2, aligning ins blocks with subs blocks.
+  ins_shift = _pad_diagonals(ins_w[1:], n_pad)
   impl = functools.partial(
       _fwd_kernel, m=m, n=n, del_cost=float(del_cost),
       loss_reg=None if loss_reg is None else float(loss_reg),
-      inf=float(inf), emit_rows=emit_rows,
+      inf=float(inf), unroll=unroll,
   )
   if emit_rows:
     kernel = impl
@@ -158,19 +214,19 @@ def _fwd_call(subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
   if emit_rows:
     # rows[k] for k = 2..m+n; rows[0:2] are closed-form, filled XLA-side.
     out_specs.append(
-        pl.BlockSpec((1, batch, m + 1), lambda g: (g, 0, 0),
+        pl.BlockSpec((unroll, batch, m + 1), lambda g: (g, 0, 0),
                      memory_space=pltpu.VMEM)
     )
     out_shape.append(
-        jax.ShapeDtypeStruct((k_dim, batch, m + 1), jnp.float32)
+        jax.ShapeDtypeStruct((n_pad, batch, m + 1), jnp.float32)
     )
   results = pl.pallas_call(
       kernel,
-      grid=(k_dim,),
+      grid=(n_blocks,),
       in_specs=[
-          pl.BlockSpec((1, batch, m), lambda g: (g, 0, 0),
+          pl.BlockSpec((unroll, batch, m), lambda g: (g, 0, 0),
                        memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, batch, m + 1), lambda g: (g + 1, 0, 0),
+          pl.BlockSpec((unroll, batch, m + 1), lambda g: (g, 0, 0),
                        memory_space=pltpu.VMEM),
           pl.BlockSpec((batch, m + 1), lambda g: (0, 0),
                        memory_space=pltpu.VMEM),
@@ -185,7 +241,9 @@ def _fwd_call(subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
           pltpu.VMEM((batch, 1), jnp.float32),
       ],
       interpret=interpret,
-  )(subs_w, ins_w, ins0, seq_lens.astype(jnp.int32)[:, None])
+  )(subs_pad, ins_shift, ins0, seq_lens.astype(jnp.int32)[:, None])
+  if emit_rows:
+    return results[0], results[1][:k_dim]
   return results
 
 
@@ -197,6 +255,7 @@ def alignment_scores(
     loss_reg: Optional[float] = None,
     inf: float = 1e9,
     interpret: bool = False,
+    unroll: Optional[int] = None,
 ) -> Array:
   """Pallas twin of wavefront.alignment_scan (same args/semantics)."""
   _, m, n = subs_costs.shape
@@ -205,6 +264,7 @@ def alignment_scores(
   (out,) = _fwd_call(
       subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
       interpret, emit_rows=False,
+      unroll=PALLAS_UNROLL if unroll is None else unroll,
   )
   return out[:, 0]
 
@@ -343,7 +403,7 @@ def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
   # Pass 1: forward recompute, streaming every DP row V[k] to HBM.
   _, rows_kernel = _fwd_call(
       subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf, interp,
-      emit_rows=True,
+      emit_rows=True, unroll=PALLAS_UNROLL,
   )
   row0, row1 = _init_rows(batch, m, ins_w[0], float(del_cost), float(inf))
   rows = jnp.concatenate(

@@ -1,0 +1,142 @@
+"""Error-analysis walkthrough over labeled examples (notebook-style).
+
+Counterpart of the reference's notebook workflow (reference:
+notebooks/ + utils/colab_utils.py:28-159): run a model over labeled
+eval windows, then break errors down per window — identity, edit
+distance, homopolymer content — print base-level diff views for the
+worst windows, and aggregate the most error-prone k-mer contexts.
+
+Usage (bundled testdata, random weights unless --checkpoint):
+
+  python scripts/error_analysis.py \
+      --examples '/root/reference/deepconsensus/testdata/human_1m/tf_examples/eval/*' \
+      [--checkpoint model_out/checkpoints/checkpoint-38] \
+      [--limit 50] [--worst 3] [--json report.json]
+"""
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(
+      description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+  ap.add_argument('--examples', required=True,
+                  help='labeled TFRecord pattern (eval/test split)')
+  ap.add_argument('--checkpoint', default=None,
+                  help='orbax checkpoint dir; random init when absent')
+  ap.add_argument('--config', default='transformer_learn_values+test')
+  ap.add_argument('--limit', type=int, default=100,
+                  help='max examples to analyze')
+  ap.add_argument('--worst', type=int, default=3,
+                  help='print diff views for this many worst windows')
+  ap.add_argument('--kmer', type=int, default=5)
+  ap.add_argument('--json', default=None,
+                  help='also write the summary as JSON here')
+  ap.add_argument('--cpu', action='store_true', help='force CPU backend')
+  args = ap.parse_args(argv)
+
+  import jax
+
+  if args.cpu:
+    jax.config.update('jax_platforms', 'cpu')
+  import jax.numpy as jnp
+  import numpy as np
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import data as data_lib
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.utils import analysis, phred
+
+  if args.checkpoint:
+    params = config_lib.read_params_from_json(args.checkpoint)
+    config_lib.finalize_params(params, is_training=False)
+  else:
+    params = config_lib.get_config(args.config)
+    config_lib.finalize_params(params, is_training=False)
+  model = model_lib.get_model(params)
+  if args.checkpoint:
+    from deepconsensus_tpu.models.checkpoints import load_params
+
+    variables = {'params': load_params(args.checkpoint)}
+  else:
+    variables = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, params.total_rows, params.max_length, 1)))
+
+  batch = 32
+  ds = data_lib.DatasetIterator(
+      patterns=args.examples, params=params, batch_size=batch,
+      shuffle=False, drop_remainder=False, limit=args.limit,
+  )
+  apply_fn = jax.jit(model.apply)
+
+  per_window = []
+  pairs = []
+  for start in range(0, len(ds.rows), batch):
+    rows = ds.rows[start:start + batch]
+    labels = ds.labels[start:start + batch]
+    preds = np.asarray(apply_fn(variables, jnp.asarray(rows)))
+    pred_ids = preds.argmax(-1)
+    for i in range(len(rows)):
+      truth = phred.encoded_sequence_to_string(
+          labels[i].astype(np.int32)).replace(' ', '')
+      pred = phred.encoded_sequence_to_string(pred_ids[i]).replace(' ', '')
+      dist = analysis.edit_distance(truth, pred)
+      # Normalize by the longer sequence so identity stays in [0, 1]
+      # even when the prediction is longer than the truth.
+      denom = max(len(truth), len(pred), 1)
+      per_window.append({
+          'index': start + i,
+          'edit_distance': dist,
+          'identity': round(1.0 - dist / denom, 4),
+          'truth_len': len(truth),
+          'pred_len': len(pred),
+          'homopolymer_content': analysis.homopolymer_content(truth),
+      })
+      pairs.append((truth, pred))
+
+  n = len(per_window)
+  idents = np.array([w['identity'] for w in per_window])
+  dists = np.array([w['edit_distance'] for w in per_window])
+  hp = np.array([w['homopolymer_content'] for w in per_window])
+  err_mask = dists > 0
+  summary = {
+      'n_windows': n,
+      'mean_identity': round(float(idents.mean()), 4),
+      'median_identity': round(float(np.median(idents)), 4),
+      'perfect_windows': int((dists == 0).sum()),
+      'mean_edit_distance': round(float(dists.mean()), 2),
+      'mean_homopolymer_content': round(float(hp.mean()), 3),
+      'mean_homopolymer_content_error_windows': (
+          round(float(hp[err_mask].mean()), 3) if err_mask.any() else None),
+      'top_error_kmers': analysis.summarize_errors(
+          pairs, k=args.kmer, top=10),
+  }
+
+  print(f'# Error analysis: {n} windows '
+        f'({"checkpoint " + args.checkpoint if args.checkpoint else "random weights"})')
+  for key, value in summary.items():
+    if key != 'top_error_kmers':
+      print(f'{key}: {value}')
+  print('top error k-mer contexts (truth-centered):')
+  for kmer, count in summary['top_error_kmers']:
+    print(f'  {kmer}: {count}')
+
+  worst = sorted(per_window, key=lambda w: w['identity'])[:args.worst]
+  for w in worst:
+    truth, pred = pairs[w['index']]
+    print(f"\n## window {w['index']}: identity {w['identity']}, "
+          f"edit distance {w['edit_distance']}, "
+          f"homopolymer {w['homopolymer_content']}")
+    print(analysis.format_diff(truth, pred))
+
+  if args.json:
+    with open(args.json, 'w') as f:
+      json.dump({'summary': summary, 'per_window': per_window}, f, indent=1)
+    print(f'\nwrote {args.json}')
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

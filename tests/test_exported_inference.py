@@ -1,5 +1,7 @@
 """Inference from an exported StableHLO artifact (SavedModel-path
 equivalent)."""
+import json
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -12,7 +14,7 @@ from deepconsensus_tpu.models import (
 )
 
 
-def test_run_inference_from_export(tmp_path, testdata_dir):
+def tiny_export(tmp_path, polymorphic=True):
   params = config_lib.get_config('transformer_learn_values+test')
   config_lib.finalize_params(params)
   with params.unlocked():
@@ -29,8 +31,15 @@ def test_run_inference_from_export(tmp_path, testdata_dir):
       batch_size=32,
       variables=variables,
       params=params,
+      polymorphic_batch=polymorphic,
   )
-  options = runner_lib.InferenceOptions(batch_zmws=4, limit=2)
+  return params, model, variables, export_dir
+
+
+def test_run_inference_from_export(tmp_path, testdata_dir):
+  params, _, _, export_dir = tiny_export(tmp_path)
+  options = runner_lib.InferenceOptions(batch_zmws=4, limit=2,
+                                        batch_size=64)
   out = str(tmp_path / 'from_export.fastq')
   counters = runner_lib.run_inference(
       subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
@@ -40,4 +49,33 @@ def test_run_inference_from_export(tmp_path, testdata_dir):
       options=options,
   )
   assert counters['n_zmw_pass'] == 2
+  # Polymorphic artifact serves the caller's batch size.
+  assert options.batch_size == 64
+
+
+def test_polymorphic_export_serves_any_batch(tmp_path):
+  """The exported artifact must match direct model.apply at batch
+  sizes other than the export-time recommendation (round-2 artifacts
+  baked one batch; the reference SavedModel serves any)."""
+  params, model, variables, export_dir = tiny_export(tmp_path)
+  with open(f'{export_dir}/export_meta.json') as f:
+    assert json.load(f)['polymorphic_batch'] is True
+  serving, _meta = export_lib.load_exported(export_dir)
+  rng = np.random.default_rng(0)
+  for batch in (3, 17):
+    rows = jnp.asarray(
+        rng.integers(0, 4, size=(batch, params.total_rows,
+                                 params.max_length, 1)).astype(np.float32))
+    got = serving(rows)
+    want = model.apply(variables, rows)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_fixed_export_pins_batch_size(tmp_path):
+  _, _, _, export_dir = tiny_export(tmp_path, polymorphic=False)
+  with open(f'{export_dir}/export_meta.json') as f:
+    assert json.load(f)['polymorphic_batch'] is False
+  options = runner_lib.InferenceOptions(batch_size=64)
+  runner_lib.ModelRunner.from_exported(export_dir, options)
   assert options.batch_size == 32  # adopted from export meta

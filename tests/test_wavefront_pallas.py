@@ -145,3 +145,22 @@ def test_alignment_loss_pallas_path_trains():
   np.testing.assert_allclose(
       np.asarray(got_g), np.asarray(want_g), rtol=1e-4, atol=1e-5
   )
+
+
+def test_auto_unroll_respects_vmem_budget():
+  """Unroll scales down with batch/width so streamed blocks stay inside
+  the VMEM budget (a fixed unroll=8 would overflow at train batch 1024)."""
+  from deepconsensus_tpu.ops import wavefront_pallas as wp
+
+  # Small problems keep the requested unroll.
+  assert wp._auto_unroll(8, 64, 24, emit_rows=False) == 8
+  # Production-ish train shapes must shrink: at B=1024, m=121 the
+  # double-buffered subs+ins stream is ~2 MB per diagonal (+1 MB with
+  # emit_rows), so 8 diagonals would blow the ~8 MB streamed budget.
+  fwd = wp._auto_unroll(8, 1024, 121, emit_rows=False)
+  bwd = wp._auto_unroll(8, 1024, 121, emit_rows=True)
+  assert 1 <= bwd <= fwd < 8
+  per_diag_fwd = 2 * 4 * 1024 * (2 * 121 + 1)
+  assert fwd * per_diag_fwd <= wp._VMEM_STREAM_BUDGET
+  # Never below 1, even for absurd shapes.
+  assert wp._auto_unroll(8, 1 << 20, 512, emit_rows=True) == 1

@@ -137,3 +137,30 @@ def test_homopolymer_content():
   assert analysis.homopolymer_content('AAAT') == 0.75
   assert analysis.homopolymer_content('AAATTT') == 1.0
   assert analysis.homopolymer_content('AA TTT') == 0.6  # gaps stripped
+
+
+def test_error_analysis_walkthrough(tmp_path, testdata_dir):
+  """The notebook-style driver runs end to end on bundled eval data
+  and emits a well-formed JSON report."""
+  import json
+  import os
+  import sys
+
+  repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  if repo_root not in sys.path:
+    sys.path.insert(0, repo_root)
+  from scripts import error_analysis
+
+  report = str(tmp_path / 'report.json')
+  rc = error_analysis.main([
+      '--examples', str(testdata_dir / 'human_1m/tf_examples/eval/*'),
+      '--limit', '8', '--worst', '1', '--json', report, '--cpu',
+  ])
+  assert rc == 0
+  with open(report) as f:
+    saved = json.load(f)
+  assert saved['summary']['n_windows'] == 8
+  assert len(saved['per_window']) == 8
+  for w in saved['per_window']:
+    assert 0.0 <= w['identity'] <= 1.0
+    assert w['edit_distance'] >= 0
