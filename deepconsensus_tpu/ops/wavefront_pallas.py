@@ -53,13 +53,13 @@ PALLAS_UNROLL = int(_os.environ.get('DC_TPU_PALLAS_UNROLL', '8'))
 _VMEM_STREAM_BUDGET = 8 * 1024 * 1024
 
 
-def _auto_unroll(requested, batch, m, emit_rows):
+def _auto_unroll(requested, batch, lanes):
   """Largest unroll <= requested whose double-buffered streamed blocks
-  (subs [u,B,m] + ins [u,B,m+1], plus rows [u,B,m+1] when emit_rows)
-  fit in _VMEM_STREAM_BUDGET."""
-  per_diag = 2 * 4 * batch * (2 * m + 1)
-  if emit_rows:
-    per_diag += 2 * 4 * batch * (m + 1)
+  fit in _VMEM_STREAM_BUDGET. `lanes` is the summed last-dim width of
+  every [unroll, B, lanes_i] block the kernel streams (inputs and
+  outputs), so per-diagonal bytes = 2 (double-buffer) * 4 (f32) * B *
+  lanes."""
+  per_diag = 2 * 4 * batch * lanes
   fit = max(1, _VMEM_STREAM_BUDGET // max(per_diag, 1))
   return max(1, min(requested, fit))
 
@@ -174,12 +174,17 @@ def _fwd_kernel(subs_ref, ins_ref, ins0_ref, lens_ref, out_ref, rows_ref,
   out_ref[:] = v_opt
 
 
-def _pad_diagonals(t, n_pad):
-  """Zero-pads a [K, ...]-leading diagonal stream to n_pad entries."""
+def _pad_diagonals(t, n_pad, front=False):
+  """Zero-pads a [K, ...]-leading diagonal stream to n_pad entries.
+
+  front=True pads before entry 0, which keeps reverse-order block
+  sweeps block-aligned (the backward kernel's block g covers the
+  highest-k diagonals when g = 0)."""
   k_dim = t.shape[0]
   if k_dim == n_pad:
     return t
-  pad_widths = [(0, n_pad - k_dim)] + [(0, 0)] * (t.ndim - 1)
+  pad = (n_pad - k_dim, 0) if front else (0, n_pad - k_dim)
+  pad_widths = [pad] + [(0, 0)] * (t.ndim - 1)
   return jnp.pad(t, pad_widths)
 
 
@@ -187,7 +192,8 @@ def _fwd_call(subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
               interpret, emit_rows, unroll):
   k_dim = subs_w.shape[0]  # m + n - 1
   batch = subs_w.shape[1]
-  unroll = _auto_unroll(unroll, batch, m, emit_rows)
+  lanes = 2 * m + 1 + ((m + 1) if emit_rows else 0)
+  unroll = _auto_unroll(unroll, batch, lanes)
   unroll = max(1, min(unroll, k_dim))
   n_blocks = -(-k_dim // unroll)
   n_pad = n_blocks * unroll
@@ -300,18 +306,23 @@ def _unwavefrontify_vec_grad(v_w: Array, n: int) -> Array:
 
 def _bwd_kernel(subs_ref, ins_ref, rows_p2_ref, rows_p1_ref, lens_ref,
                 g_ref, dsubs_ref, dins_ref, dv1_ref, dA_ref, dB_ref, *,
-                m, n, del_cost, loss_reg, inf, k_total):
-  """Reverse adjoint sweep; grid step g handles diagonal k = (m+n) - g.
+                m, n, del_cost, loss_reg, inf, k_total, unroll):
+  """Reverse adjoint sweep; grid step g handles diagonals
+  k = j + 2 for j = (k_total-1) - (g+1)*unroll + u, u descending.
 
-  The index maps stream subs[k-2], ins[k-1] and the recorded DP rows
-  V[k-2], V[k-1] in *reverse* diagonal order. Carry: dA = adjoint of
-  V[k], dB = adjoint of V[k-1]. Step k spreads dA onto the three
-  predecessor rows weighted by the recomputed soft-min weights and
-  emits the cost-gradient diagonals dsubs[k-2], dins[k-1].
+  Every stream (subs[k-2], ins[k-1], recorded DP rows V[k-2], V[k-1],
+  and the emitted gradients) is indexed by j = k - 2 and front-padded
+  to a multiple of `unroll`, so the reverse sweep walks whole blocks
+  from the high-k end (block index n_blocks-1-g) and stays
+  block-aligned. Front-padding entries have k < 2; their carry
+  updates are masked out (their block writes land in the padding,
+  sliced off by the caller). Carry: dA = adjoint of V[k], dB =
+  adjoint of V[k-1]. Step k spreads dA onto the three predecessor
+  rows weighted by the recomputed soft-min weights and emits the
+  cost-gradient diagonals dsubs[k-2], dins[k-1].
   """
   del inf
   g = pl.program_id(0)
-  k = k_total - g
   b = dA_ref.shape[0]
   i_range = jax.lax.broadcasted_iota(jnp.int32, (1, m + 1), 1)
   lens = lens_ref[:, 0]
@@ -324,31 +335,43 @@ def _bwd_kernel(subs_ref, ins_ref, rows_p2_ref, rows_p1_ref, lens_ref,
   def _init():
     dA_ref[:] = jnp.zeros((b, m + 1), jnp.float32)
     dB_ref[:] = jnp.zeros((b, m + 1), jnp.float32)
+    dv1_ref[:] = jnp.zeros((b, m + 1), jnp.float32)
 
-  valid = (k - i_range >= 0) & (k - i_range <= n)
-  inject = g_ref[:, :1] * onehot_len * (k_end == k)[:, None].astype(
-      jnp.float32
-  )
-  dA = jnp.where(valid, dA_ref[:] + inject, 0.0)
-
-  w = _recompute_band(
-      k, rows_p2_ref[0], rows_p1_ref[0], subs_ref[0], ins_ref[0],
-      del_cost, loss_reg,
-  )
-  dbody = dA[:, 1:]
-  d_m = w[0] * dbody
-  d_i1 = w[1] * dbody
-  d_d = w[2] * dbody
-  dsubs_ref[0] = d_m
-  dins_row = jnp.concatenate([dA[:, :1], d_i1], axis=1)
-  dins_ref[0] = dins_row
+  dA_c = dA_ref[:]
+  dB_c = dB_ref[:]
+  dv1 = dv1_ref[:]
   zero_col = jnp.zeros((b, 1), jnp.float32)
-  dB_new = dB_ref[:] + dins_row + jnp.concatenate(
-      [d_d, zero_col], axis=1
-  )
-  dA_ref[:] = dB_new
-  dB_ref[:] = jnp.concatenate([d_m, zero_col], axis=1)
-  dv1_ref[:] = dB_new  # final value (at g = k_total - 2) is dV[1]
+  for u in reversed(range(unroll)):
+    k = (k_total - 1) - (g + 1) * unroll + u + 2
+    valid = (k - i_range >= 0) & (k - i_range <= n)
+    inject = g_ref[:, :1] * onehot_len * (k_end == k)[:, None].astype(
+        jnp.float32
+    )
+    dA = jnp.where(valid, dA_c + inject, 0.0)
+
+    w = _recompute_band(
+        k, rows_p2_ref[u], rows_p1_ref[u], subs_ref[u], ins_ref[u],
+        del_cost, loss_reg,
+    )
+    dbody = dA[:, 1:]
+    d_m = w[0] * dbody
+    d_i1 = w[1] * dbody
+    d_d = w[2] * dbody
+    dsubs_ref[u] = d_m
+    dins_row = jnp.concatenate([dA[:, :1], d_i1], axis=1)
+    dins_ref[u] = dins_row
+    dB_new = dB_c + dins_row + jnp.concatenate([d_d, zero_col], axis=1)
+    # Front-padding diagonals (k < 2) must not advance the carry: the
+    # final dv1 (written at k = 2) is the closed-form dV[1] adjoint.
+    ok = k >= 2
+    dA_c = jnp.where(ok, dB_new, dA_c)
+    dB_c = jnp.where(
+        ok, jnp.concatenate([d_m, zero_col], axis=1), dB_c
+    )
+    dv1 = jnp.where(ok, dB_new, dv1)
+  dA_ref[:] = dA_c
+  dB_ref[:] = dB_c
+  dv1_ref[:] = dv1
 
 
 def _scores_fwd_impl(subs_costs, ins_costs, seq_lens, del_cost, loss_reg,
@@ -410,46 +433,53 @@ def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
       [row0[None], row1[None], rows_kernel], axis=0
   )  # [m+n+1, B, m+1], rows[k] = V[k]
 
-  # Pass 2: reverse sweep. Step g handles k = k_total - g; the index
-  # maps walk subs/ins/rows diagonals backwards.
-  d_subs_w, d_ins_w, dv1 = pl.pallas_call(
+  # Pass 2: reverse sweep in blocks of `unroll` diagonals. Every
+  # stream is re-indexed by j = k - 2 (subs[j], ins_w[j+1], V[j],
+  # V[j+1], gradients) and front-padded to a block multiple, so block
+  # n_blocks-1-g holds the g-th-from-the-top group of diagonals and
+  # the kernel walks u descending inside it.
+  # Backward streams 6 [unroll, B, ~m] blocks per diagonal (4 in,
+  # 2 out), so the VMEM-fitted unroll is smaller than the forward's.
+  unroll = _auto_unroll(PALLAS_UNROLL, batch, 6 * m + 4)
+  unroll = max(1, min(unroll, k_dim))
+  n_blocks = -(-k_dim // unroll)
+  n_pad = n_blocks * unroll
+  subs_b = _pad_diagonals(subs_w, n_pad, front=True)
+  ins_b = _pad_diagonals(ins_w[1:], n_pad, front=True)
+  rows_p2_b = _pad_diagonals(rows[:-2], n_pad, front=True)
+  rows_p1_b = _pad_diagonals(rows[1:-1], n_pad, front=True)
+  rev_spec_m = pl.BlockSpec(
+      (unroll, batch, m), lambda gi: (n_blocks - 1 - gi, 0, 0),
+      memory_space=pltpu.VMEM)
+  rev_spec_m1 = pl.BlockSpec(
+      (unroll, batch, m + 1), lambda gi: (n_blocks - 1 - gi, 0, 0),
+      memory_space=pltpu.VMEM)
+  d_subs_pad, d_ins_pad, dv1 = pl.pallas_call(
       functools.partial(
           _bwd_kernel, m=m, n=n, del_cost=float(del_cost),
           loss_reg=None if loss_reg is None else float(loss_reg),
-          inf=float(inf), k_total=k_total,
+          inf=float(inf), k_total=k_total, unroll=unroll,
       ),
-      grid=(k_dim,),
+      grid=(n_blocks,),
       in_specs=[
-          pl.BlockSpec((1, batch, m),
-                       lambda gi: (k_total - gi - 2, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, batch, m + 1),
-                       lambda gi: (k_total - gi - 1, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, batch, m + 1),
-                       lambda gi: (k_total - gi - 2, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, batch, m + 1),
-                       lambda gi: (k_total - gi - 1, 0, 0),
-                       memory_space=pltpu.VMEM),
+          rev_spec_m,
+          rev_spec_m1,
+          rev_spec_m1,
+          rev_spec_m1,
           pl.BlockSpec((batch, 1), lambda gi: (0, 0),
                        memory_space=pltpu.VMEM),
           pl.BlockSpec((batch, 1), lambda gi: (0, 0),
                        memory_space=pltpu.VMEM),
       ],
       out_specs=[
-          pl.BlockSpec((1, batch, m),
-                       lambda gi: (k_total - gi - 2, 0, 0),
-                       memory_space=pltpu.VMEM),
-          pl.BlockSpec((1, batch, m + 1),
-                       lambda gi: (k_total - gi - 1, 0, 0),
-                       memory_space=pltpu.VMEM),
+          rev_spec_m,
+          rev_spec_m1,
           pl.BlockSpec((batch, m + 1), lambda gi: (0, 0),
                        memory_space=pltpu.VMEM),
       ],
       out_shape=[
-          jax.ShapeDtypeStruct((k_dim, batch, m), jnp.float32),
-          jax.ShapeDtypeStruct((k_dim + 1, batch, m + 1), jnp.float32),
+          jax.ShapeDtypeStruct((n_pad, batch, m), jnp.float32),
+          jax.ShapeDtypeStruct((n_pad, batch, m + 1), jnp.float32),
           jax.ShapeDtypeStruct((batch, m + 1), jnp.float32),
       ],
       scratch_shapes=[
@@ -457,16 +487,18 @@ def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
           pltpu.VMEM((batch, m + 1), jnp.float32),
       ],
       interpret=interp,
-  )(subs_w, ins_w, rows, rows, seq_lens.astype(jnp.int32)[:, None],
-    g.astype(jnp.float32)[:, None])
+  )(subs_b, ins_b, rows_p2_b, rows_p1_b,
+    seq_lens.astype(jnp.int32)[:, None], g.astype(jnp.float32)[:, None])
 
-  # The kernel never visits dins block 0 (its diagonal index stops at
-  # 1); V[1][0] = ins_w[0][:, 0] is the only input-dependent init
-  # entry, so dins[0] comes from the dV[1] carry.
-  d_ins_w = d_ins_w.at[0].set(
-      jnp.concatenate(
+  d_subs_w = d_subs_pad[n_pad - k_dim:]
+  # The kernel emits dins at j = k - 2 >= 0, i.e. ins_w entries 1..;
+  # V[1][0] = ins_w[0][:, 0] is the only input-dependent init entry,
+  # so dins[0] comes from the dV[1] carry.
+  d_ins_w = jnp.concatenate(
+      [jnp.concatenate(
           [dv1[:, :1], jnp.zeros((batch, m), jnp.float32)], axis=1
-      )
+      )[None],
+       d_ins_pad[n_pad - k_dim:]], axis=0
   )
   d_subs = _unwavefrontify(d_subs_w, n).astype(subs_costs.dtype)
   d_ins = _unwavefrontify_vec_grad(d_ins_w, n).astype(ins_costs.dtype)

@@ -153,14 +153,17 @@ def test_auto_unroll_respects_vmem_budget():
   from deepconsensus_tpu.ops import wavefront_pallas as wp
 
   # Small problems keep the requested unroll.
-  assert wp._auto_unroll(8, 64, 24, emit_rows=False) == 8
+  assert wp._auto_unroll(8, 64, 2 * 24 + 1) == 8
   # Production-ish train shapes must shrink: at B=1024, m=121 the
-  # double-buffered subs+ins stream is ~2 MB per diagonal (+1 MB with
-  # emit_rows), so 8 diagonals would blow the ~8 MB streamed budget.
-  fwd = wp._auto_unroll(8, 1024, 121, emit_rows=False)
-  bwd = wp._auto_unroll(8, 1024, 121, emit_rows=True)
-  assert 1 <= bwd <= fwd < 8
-  per_diag_fwd = 2 * 4 * 1024 * (2 * 121 + 1)
+  # double-buffered subs+ins stream is ~2 MB per diagonal (+1 MB of
+  # emitted rows in the recompute pass, ~3 MB more in the 6-stream
+  # reverse sweep), so 8 diagonals would blow the ~8 MB budget.
+  m, b = 121, 1024
+  fwd = wp._auto_unroll(8, b, 2 * m + 1)
+  rec = wp._auto_unroll(8, b, 2 * m + 1 + (m + 1))
+  bwd = wp._auto_unroll(8, b, 6 * m + 4)
+  assert 1 <= bwd <= rec <= fwd < 8
+  per_diag_fwd = 2 * 4 * b * (2 * m + 1)
   assert fwd * per_diag_fwd <= wp._VMEM_STREAM_BUDGET
   # Never below 1, even for absurd shapes.
-  assert wp._auto_unroll(8, 1 << 20, 512, emit_rows=True) == 1
+  assert wp._auto_unroll(8, 1 << 20, 6 * 512 + 4) == 1
