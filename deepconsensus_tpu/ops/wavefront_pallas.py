@@ -253,6 +253,26 @@ def _fwd_call(subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
   return results
 
 
+def _scores_and_rows(subs_costs, ins_costs, del_cost, seq_lens, loss_reg,
+                     inf, interpret, emit_rows, unroll=None):
+  """Shared forward pipeline (wavefrontify + kernel call) for the plain
+  scorer and the custom-VJP fwd rule — one copy, so the rule's output
+  can never drift from the primal's. Returns (scores, rows|None)."""
+  _, m, n = subs_costs.shape
+  subs_w = wavefrontify32(subs_costs)  # [K, B, m]
+  ins_w = wavefrontify_vec32(ins_costs, m + 1)  # [K+1, B, m+1]
+  res = _fwd_call(
+      subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
+      interpret, emit_rows=emit_rows,
+      unroll=PALLAS_UNROLL if unroll is None else unroll,
+  )
+  if emit_rows:
+    out, rows = res
+    return out[:, 0], rows
+  (out,) = res
+  return out[:, 0], None
+
+
 def alignment_scores(
     subs_costs: Array,
     ins_costs: Array,
@@ -264,15 +284,11 @@ def alignment_scores(
     unroll: Optional[int] = None,
 ) -> Array:
   """Pallas twin of wavefront.alignment_scan (same args/semantics)."""
-  _, m, n = subs_costs.shape
-  subs_w = wavefrontify32(subs_costs)  # [K, B, m]
-  ins_w = wavefrontify_vec32(ins_costs, m + 1)  # [K+1, B, m+1]
-  (out,) = _fwd_call(
-      subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf,
-      interpret, emit_rows=False,
-      unroll=PALLAS_UNROLL if unroll is None else unroll,
+  out, _ = _scores_and_rows(
+      subs_costs, ins_costs, del_cost, seq_lens, loss_reg, inf,
+      interpret, emit_rows=False, unroll=unroll,
   )
-  return out[:, 0]
+  return out
 
 
 def wavefrontify32(t: Array) -> Array:
@@ -375,10 +391,10 @@ def _bwd_kernel(subs_ref, ins_ref, rows_p2_ref, rows_p1_ref, lens_ref,
 
 
 def _scores_fwd_impl(subs_costs, ins_costs, seq_lens, del_cost, loss_reg,
-                     inf, interpret):
-  return alignment_scores(
-      subs_costs, ins_costs, del_cost, seq_lens, loss_reg=loss_reg,
-      inf=inf, interpret=pallas_util.resolve_interpret(interpret),
+                     inf, interpret, emit_rows=False):
+  return _scores_and_rows(
+      subs_costs, ins_costs, del_cost, seq_lens, loss_reg, inf,
+      pallas_util.resolve_interpret(interpret), emit_rows=emit_rows,
   )
 
 
@@ -397,37 +413,41 @@ def alignment_scores_vjp(
   Same scores as `alignment_scores`; gradients w.r.t. subs_costs and
   ins_costs come from the pipelined backward kernels.
   """
-  return _scores_fwd_impl(
+  out, _ = _scores_fwd_impl(
       subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
       interpret,
   )
+  return out
 
 
 def _vjp_fwd(subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
              interpret):
-  out = _scores_fwd_impl(
+  # Run the forward with emit_rows=True and save every DP row V[k] as
+  # a residual: the backward then starts directly at the reverse
+  # adjoint sweep instead of re-running the whole forward DP (one of
+  # three otherwise-equal-cost sweeps per training step). The rows
+  # residual is [m+n+1, B, m+1] f32 in HBM — ~110 MB at B=1024,
+  # m=121, well inside a v5e's 16 GB. The cost tensors are saved in
+  # their original [B, m, n] layout/dtype; the backward re-derives the
+  # wavefrontified views (a cheap XLA gather next to the DP sweep).
+  out, rows_kernel = _scores_fwd_impl(
       subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-      interpret,
+      interpret, emit_rows=True,
   )
-  return out, (subs_costs, ins_costs, seq_lens)
+  return out, (subs_costs, ins_costs, seq_lens, rows_kernel)
 
 
 def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
   import numpy as np
 
-  subs_costs, ins_costs, seq_lens = res
+  subs_costs, ins_costs, seq_lens, rows_kernel = res
   batch, m, n = subs_costs.shape
-  interp = pallas_util.resolve_interpret(interpret)
   subs_w = wavefrontify32(subs_costs)
   ins_w = wavefrontify_vec32(ins_costs, m + 1)
   k_dim = subs_w.shape[0]  # m + n - 1
+  interp = pallas_util.resolve_interpret(interpret)
   k_total = m + n
 
-  # Pass 1: forward recompute, streaming every DP row V[k] to HBM.
-  _, rows_kernel = _fwd_call(
-      subs_w, ins_w, seq_lens, m, n, del_cost, loss_reg, inf, interp,
-      emit_rows=True, unroll=PALLAS_UNROLL,
-  )
   row0, row1 = _init_rows(batch, m, ins_w[0], float(del_cost), float(inf))
   rows = jnp.concatenate(
       [row0[None], row1[None], rows_kernel], axis=0
