@@ -2,23 +2,25 @@
 
 The alignment score is an anti-diagonal DP with a sequential dependence
 over k = i + j. The TPU-native formulation here makes the *grid* the
-diagonal axis: each grid step consumes one streamed diagonal block of
-the wavefrontified cost tensors (Pallas double-buffers the HBM->VMEM
-DMAs automatically) and updates carry rows held in VMEM scratch that
-persist across grid steps. The full batch rides the vector lanes of
-every step, so per-step work is a [B, m+1] vector op instead of the
-[batch_tile, m+1] slice a whole-DP-in-VMEM kernel is limited to, and
-VMEM holds two diagonals instead of the entire cost tensor.
+diagonal axis: each grid step consumes a streamed block of `unroll`
+diagonals of the wavefrontified cost tensors (Pallas double-buffers
+the HBM->VMEM DMAs automatically) and updates carry rows held in VMEM
+scratch that persist across grid steps. The full batch rides the
+vector lanes of every step, so per-step work is `unroll` [B, m+1]
+vector ops instead of the [batch_tile, m+1] slice a whole-DP-in-VMEM
+kernel is limited to, and VMEM holds a few diagonal blocks instead of
+the entire cost tensor.
 
 `alignment_scores` is the forward scorer matching
 ops/wavefront.alignment_scan semantics exactly; `alignment_scores_vjp`
-wraps it in a jax.custom_vjp whose backward runs two more pipelined
-kernels (forward recompute streaming every DP row to HBM, then a
-reverse-order adjoint sweep whose index maps walk the diagonals
-backwards), so AlignmentLoss trains through Pallas end-to-end (the
-reference trains through this DP: losses_and_metrics.py:346-411).
-Validated against alignment_scan values and jax.grad in interpret mode
-and on TPU hardware.
+wraps it in a jax.custom_vjp: the forward rule streams every DP row
+V[k] to HBM and saves them as residuals, and the backward runs one
+reverse-order adjoint sweep whose blocks walk the diagonals backwards
+(soft-min weights recomputed per diagonal from the saved rows), so
+AlignmentLoss trains through Pallas end-to-end in two DP sweeps per
+step (the reference trains through this DP:
+losses_and_metrics.py:346-411). Validated against alignment_scan
+values and jax.grad in interpret mode and on TPU hardware.
 """
 from __future__ import annotations
 
@@ -87,9 +89,9 @@ def _init_rows(b, m, ins0, del_cost, inf):
 
 def _dp_step(k, v_p2, v_p1, subs_k, ins_k, *, i_range, n, del_cost,
              minop, inf):
-  """One anti-diagonal update, shared by the forward scorer and the
-  backward kernel's recompute pass (drift here would silently decouple
-  loss values from gradients)."""
+  """One anti-diagonal update (forward scorer; the backward recomputes
+  its soft-min weights from the rows this step produced, so drift here
+  would silently decouple loss values from gradients)."""
   valid = (k - i_range >= 0) & (k - i_range <= n)
   o_m = v_p2 + subs_k
   o_i = v_p1 + ins_k

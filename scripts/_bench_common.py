@@ -1,0 +1,67 @@
+"""Shared setup for the train-step bench scripts.
+
+One copy of the Trainer construction, synthetic-batch featurization
+(mirroring the stacked-row layout models/data.py produces), and the
+transfer-free scalar train step, so scripts/bench_train_scaling.py and
+scripts/bench_train_stages.py cannot drift apart.
+"""
+
+
+def make_trainer_and_batch(batch, use_scan_dp=False,
+                           out_dir='/tmp/dc_bench_train'):
+  """Returns (trainer, state, rows_t, label) for the test config at
+  the given batch size; use_scan_dp pins the lax.scan DP instead of
+  the TPU-default Pallas wavefront."""
+  import jax.numpy as jnp
+  import numpy as np
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import train as train_lib
+
+  tp = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(tp)
+  with tp.unlocked():
+    tp.batch_size = batch
+    tp.use_pallas_wavefront = False if use_scan_dp else None
+  trainer = train_lib.Trainer(params=tp, out_dir=out_dir, mesh=None)
+  state = trainer.init_state(steps_total=100)
+
+  rng = np.random.default_rng(2)
+  rows = np.zeros((batch, tp.total_rows, tp.max_length, 1), np.float32)
+  mp = tp.max_passes
+  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)  # bases
+  rows[:, mp:3 * mp] = rng.integers(  # pw, ip
+      0, 256, size=rows[:, mp:3 * mp].shape)
+  rows[:, 3 * mp:4 * mp] = rng.integers(  # strand
+      0, 3, size=rows[:, 3 * mp:4 * mp].shape)
+  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)  # ccs
+  rows[:, 4 * mp + 1:] = rng.integers(  # sn
+      0, 501, size=rows[:, 4 * mp + 1:].shape)
+  rows_t = jnp.asarray(rows)
+  label = jnp.asarray(
+      rng.integers(0, 5, size=(batch, tp.max_length)), jnp.int32)
+  return trainer, state, rows_t, label
+
+
+def make_scalar_step(state, loss_fn):
+  """Jitted train step returning only scalars (loss + a parameter
+  fingerprint that keeps the LAMB update live against DCE), so timing
+  excludes device->host tensor transfers."""
+  import jax
+  import jax.numpy as jnp
+
+  def step(state, rows, label):
+    rng_step = jax.random.fold_in(state.dropout_rng, state.step)
+
+    def loss_of(p):
+      preds = state.apply_fn(
+          {'params': p}, rows, train=True, rngs={'dropout': rng_step}
+      )
+      return loss_fn(label, preds)
+
+    loss, grads = jax.value_and_grad(loss_of)(state.params)
+    new_state = state.apply_gradients(grads=grads)
+    fp = sum(jnp.sum(x) for x in jax.tree.leaves(new_state.params))
+    return loss, fp
+
+  del state
+  return jax.jit(step)

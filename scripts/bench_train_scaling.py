@@ -25,50 +25,16 @@ def main():
 
   if args.cpu:
     jax.config.update('jax_platforms', 'cpu')
-  import jax.numpy as jnp
   import numpy as np
-  from deepconsensus_tpu.models import config as config_lib
-  from deepconsensus_tpu.models import train as train_lib
+
+  from scripts import _bench_common
 
   for batch in args.batches:
-    tp = config_lib.get_config('transformer_learn_values+test')
-    config_lib.finalize_params(tp)
-    with tp.unlocked():
-      tp.batch_size = batch
-      tp.use_pallas_wavefront = False if args.scan else None
-    trainer = train_lib.Trainer(
-        params=tp, out_dir='/tmp/dc_bench_train_scaling', mesh=None
+    trainer, state, rows_t, label = _bench_common.make_trainer_and_batch(
+        batch, use_scan_dp=args.scan,
+        out_dir='/tmp/dc_bench_train_scaling',
     )
-    state = trainer.init_state(steps_total=100)
-    loss_obj = trainer.loss_fn
-    rng = np.random.default_rng(2)
-    rows = np.zeros((batch, tp.total_rows, tp.max_length, 1), np.float32)
-    mp = tp.max_passes
-    rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)
-    rows[:, mp:3 * mp] = rng.integers(0, 256, size=rows[:, mp:3 * mp].shape)
-    rows[:, 3 * mp:4 * mp] = rng.integers(0, 3, size=rows[:, :mp].shape)
-    rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)
-    rows[:, 4 * mp + 1:] = rng.integers(0, 501,
-                                        size=rows[:, 4 * mp + 1:].shape)
-    rows_t = jnp.asarray(rows)
-    label = jnp.asarray(
-        rng.integers(0, 5, size=(batch, tp.max_length)), jnp.int32)
-
-    def step_scalar(state, rows, label):
-      rng_step = jax.random.fold_in(state.dropout_rng, state.step)
-
-      def loss_of(p):
-        preds = state.apply_fn(
-            {'params': p}, rows, train=True, rngs={'dropout': rng_step}
-        )
-        return loss_obj(label, preds)
-
-      loss, grads = jax.value_and_grad(loss_of)(state.params)
-      new_state = state.apply_gradients(grads=grads)
-      fp = sum(jnp.sum(x) for x in jax.tree.leaves(new_state.params))
-      return loss, fp
-
-    step_fn = jax.jit(step_scalar)
+    step_fn = _bench_common.make_scalar_step(state, trainer.loss_fn)
     row = {'batch': batch,
            'dp': 'scan' if args.scan else 'pallas(auto)'}
     try:
