@@ -1,0 +1,51 @@
+#!/bin/bash
+# One-shot round-3 TPU measurement sweep. Run when the tunnel is alive:
+#   bash scripts/measure_r3.sh
+# Each stage has its own timeout so a tunnel hang mid-sweep keeps the
+# completed stages; results accumulate in /root/repo/MEASURED_TPU_r3.d/
+# and are merged into MEASURED_TPU_r3.json at the end (also safe to
+# re-run: stages overwrite their own output files only on success).
+#
+# IMPORTANT (1-core host): stop background CPU jobs (the overfit
+# trainer, pytest) before running, or host-side stages are poisoned.
+set -u
+REPO=/root/repo
+OUT=$REPO/MEASURED_TPU_r3.d
+mkdir -p "$OUT"
+export PYTHONPATH=$REPO:/root/.axon_site
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/root/.dc_jax_cache}
+
+run_stage() {  # name timeout_s cmd...
+  local name=$1 t=$2; shift 2
+  echo "=== stage $name (timeout ${t}s) ==="
+  if timeout "$t" "$@" > "$OUT/$name.tmp" 2> "$OUT/$name.err"; then
+    grep -E '^\{' "$OUT/$name.tmp" > "$OUT/$name.jsonl" || true
+    tail -3 "$OUT/$name.jsonl"
+  else
+    echo "stage $name FAILED rc=$? (see $OUT/$name.err)"
+  fi
+}
+
+# Cheapest first so a fragile tunnel still yields the headline numbers.
+run_stage train_stages_b256 900 \
+  python "$REPO/scripts/bench_train_stages.py" --batches 256 --steps 6 --scan-too
+run_stage e2e 1200 \
+  python "$REPO/scripts/bench_e2e.py" --repeats 6
+run_stage train_scaling 1200 \
+  python "$REPO/scripts/bench_train_scaling.py" --batches 256 1024 --steps 6
+run_stage train_stages_b1024 900 \
+  python "$REPO/scripts/bench_train_stages.py" --batches 1024 --steps 6
+run_stage flash_band 900 \
+  python "$REPO/scripts/bench_flash_band.py"
+
+python - <<'EOF'
+import json, os, glob
+out = {}
+d = '/root/repo/MEASURED_TPU_r3.d'
+for f in sorted(glob.glob(os.path.join(d, '*.jsonl'))):
+    rows = [json.loads(l) for l in open(f) if l.strip()]
+    out[os.path.basename(f)[:-6]] = rows
+with open('/root/repo/MEASURED_TPU_r3.json', 'w') as fh:
+    json.dump(out, fh, indent=1)
+print('merged ->', '/root/repo/MEASURED_TPU_r3.json')
+EOF
