@@ -1,0 +1,117 @@
+"""Read-level polished-vs-truth assessment with in-repo tools only.
+
+The reference's yield@Q workflow maps polished reads back to the truth
+assembly with an external aligner before `yield_metrics` (reference:
+docs/yield_metrics.md); the aligner stays out-of-repo (L0 external
+tools). For the bundled 10-ZMW testdata the truth sequence *per ZMW*
+is already available from truth_to_ccs.bam, so this script scores each
+polished read directly: Levenshtein identity and empirical QV of the
+polished sequence and of the raw CCS sequence against that ZMW's
+truth, plus the read's mean predicted quality. That is the read-level
+counterpart of the window eval metrics (eval/identity_pred vs
+eval/identity_ccs) and closes the train -> run -> truth loop for the
+training-accuracy artifact.
+
+Usage:
+  python scripts/eval_polished_vs_truth.py \
+      --polished polished.fastq \
+      --ccs_bam testdata/human_1m/ccs.bam \
+      --truth_to_ccs testdata/human_1m/truth_to_ccs.bam \
+      [--json report.json]
+"""
+import argparse
+import json
+import math
+import sys
+
+
+def _empirical_qv(dist, length):
+  if length == 0:
+    return 0.0
+  err = max(dist, 0) / length
+  if err <= 0:
+    # Error-free at this length; cap like QV tools do.
+    return round(10.0 * math.log10(length), 1)
+  return round(-10.0 * math.log10(err), 1)
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument('--polished', required=True, help='polished FASTQ')
+  ap.add_argument('--ccs_bam', required=True)
+  ap.add_argument('--truth_to_ccs', required=True)
+  ap.add_argument('--json', default=None)
+  args = ap.parse_args(argv)
+
+  from deepconsensus_tpu.io import bam as bam_lib
+  from deepconsensus_tpu.io import fastx
+  from deepconsensus_tpu.utils import analysis, phred
+
+  truth_by_ccs_name = {}
+  for rec in bam_lib.BamReader(args.truth_to_ccs):
+    # Primary alignments only: a supplementary/secondary record carries
+    # a hard-clipped fragment that must not replace the full truth seq
+    # (same guard as preprocess/feeder.py and calibration/measure.py).
+    if rec.is_supplementary or rec.is_secondary:
+      continue
+    if rec.reference_name is not None and rec.seq:
+      truth_by_ccs_name[rec.reference_name] = rec.seq
+  ccs_by_name = {
+      rec.qname: rec.seq for rec in bam_lib.BamReader(args.ccs_bam)
+      if not (rec.is_supplementary or rec.is_secondary)
+  }
+  polished = {
+      name: (seq, qual) for name, seq, qual in fastx.read_fastq(
+          args.polished)
+  }
+
+  rows = []
+  for name, (seq, qual) in sorted(polished.items()):
+    truth = truth_by_ccs_name.get(name)
+    ccs_seq = ccs_by_name.get(name)
+    if truth is None or ccs_seq is None:
+      print(f'# {name}: no bundled truth/ccs record, skipped',
+            file=sys.stderr)
+      continue
+    d_pred = analysis.edit_distance(seq, truth)
+    d_ccs = analysis.edit_distance(ccs_seq, truth)
+    tl = len(truth)
+    rows.append({
+        'read': name,
+        'len_polished': len(seq),
+        'len_truth': tl,
+        'identity_polished': round(1.0 - d_pred / max(tl, 1), 5),
+        'identity_ccs': round(1.0 - d_ccs / max(tl, 1), 5),
+        'qv_polished': _empirical_qv(d_pred, tl),
+        'qv_ccs': _empirical_qv(d_ccs, tl),
+        'mean_pred_q': round(
+            phred.avg_phred(phred.quality_string_to_array(qual)), 1),
+    })
+
+  if not rows:
+    print('no scorable reads', file=sys.stderr)
+    return 1
+  n = len(rows)
+  summary = {
+      'n_reads': n,
+      'mean_identity_polished': round(
+          sum(r['identity_polished'] for r in rows) / n, 5),
+      'mean_identity_ccs': round(
+          sum(r['identity_ccs'] for r in rows) / n, 5),
+      'mean_qv_polished': round(
+          sum(r['qv_polished'] for r in rows) / n, 1),
+      'mean_qv_ccs': round(sum(r['qv_ccs'] for r in rows) / n, 1),
+      'reads_polished_better_or_equal': sum(
+          1 for r in rows if r['qv_polished'] >= r['qv_ccs']),
+  }
+  print(json.dumps(summary))
+  for r in rows:
+    print(json.dumps(r))
+  if args.json:
+    with open(args.json, 'w') as f:
+      json.dump({'summary': summary, 'per_read': rows}, f, indent=1)
+  return 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())
