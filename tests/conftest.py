@@ -35,3 +35,15 @@ def testdata_dir() -> pathlib.Path:
   if not REFERENCE_TESTDATA.exists():
     pytest.skip('reference testdata not available')
   return REFERENCE_TESTDATA
+
+
+@pytest.fixture(scope='session')
+def scripts_importable():
+  """Puts the repo root on sys.path so tests can import the scripts/
+  package regardless of the checkout location."""
+  import sys
+
+  repo_root = str(pathlib.Path(__file__).resolve().parent.parent)
+  if repo_root not in sys.path:
+    sys.path.insert(0, repo_root)
+  return repo_root

@@ -139,16 +139,12 @@ def test_homopolymer_content():
   assert analysis.homopolymer_content('AA TTT') == 0.6  # gaps stripped
 
 
-def test_error_analysis_walkthrough(tmp_path, testdata_dir):
+def test_error_analysis_walkthrough(tmp_path, testdata_dir,
+                                    scripts_importable):
   """The notebook-style driver runs end to end on bundled eval data
   and emits a well-formed JSON report."""
   import json
-  import os
-  import sys
 
-  repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-  if repo_root not in sys.path:
-    sys.path.insert(0, repo_root)
   from scripts import error_analysis
 
   report = str(tmp_path / 'report.json')
@@ -164,3 +160,45 @@ def test_error_analysis_walkthrough(tmp_path, testdata_dir):
   for w in saved['per_window']:
     assert 0.0 <= w['identity'] <= 1.0
     assert w['edit_distance'] >= 0
+
+
+def test_eval_polished_vs_truth_scoring(tmp_path, testdata_dir,
+                                        scripts_importable):
+  """The read-level truth scorer: a FASTQ that echoes each ZMW's truth
+  sequence must score identity 1.0 and beat (or tie) the CCS read.
+  (The bundled truth BAM has primaries only, so the script's
+  supplementary-record guard is not exercised here.)"""
+  import json
+
+  from scripts import eval_polished_vs_truth
+
+  from deepconsensus_tpu.io import bam as bam_lib
+
+  truth_bam = str(testdata_dir / 'human_1m/truth_to_ccs.bam')
+  ccs_bam = str(testdata_dir / 'human_1m/ccs.bam')
+  truths = {}
+  for rec in bam_lib.BamReader(truth_bam):
+    if rec.is_supplementary or rec.is_secondary:
+      continue
+    if rec.reference_name and rec.seq and rec.reference_name not in truths:
+      truths[rec.reference_name] = rec.seq
+  names = sorted(truths)[:2]
+  fastq = tmp_path / 'perfect.fastq'
+  with open(fastq, 'w') as f:
+    for name in names:
+      seq = truths[name]
+      f.write(f'@{name}\n{seq}\n+\n{"I" * len(seq)}\n')
+
+  report = str(tmp_path / 'report.json')
+  rc = eval_polished_vs_truth.main([
+      '--polished', str(fastq), '--ccs_bam', ccs_bam,
+      '--truth_to_ccs', truth_bam, '--json', report,
+  ])
+  assert rc == 0
+  with open(report) as f:
+    saved = json.load(f)
+  assert saved['summary']['n_reads'] == len(names)
+  for row in saved['per_read']:
+    assert row['identity_polished'] == 1.0
+    assert row['qv_polished'] >= row['qv_ccs']
+    assert row['mean_pred_q'] == 40.0  # 'I' = Q40
