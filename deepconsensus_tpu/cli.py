@@ -38,6 +38,18 @@ def _coerce_override(raw: str, current):
   return raw
 
 
+def _apply_overrides(params, overrides: List[str]) -> None:
+  """Applies --set KEY=VALUE items to an unlocked-able config. Must run
+  before finalize_params so derived values (total_rows, hidden_size)
+  see the overrides."""
+  with params.unlocked():
+    for item in overrides:
+      key, eq, raw = item.partition('=')
+      if not eq or not hasattr(params, key):
+        raise ValueError(f'unknown config override {item!r}')
+      setattr(params, key, _coerce_override(raw, getattr(params, key)))
+
+
 def _add_preprocess(sub):
   p = sub.add_parser('preprocess', help='Generate examples from BAMs.')
   p.add_argument('--subreads_to_ccs', required=True)
@@ -172,6 +184,11 @@ def _add_distill(sub):
   p.add_argument('--train_path', nargs='*')
   p.add_argument('--eval_path', nargs='*')
   p.add_argument('--num_epochs', type=int)
+  p.add_argument('--batch_size', type=int)
+  p.add_argument('--set', action='append', default=[], metavar='KEY=VALUE',
+                 dest='overrides',
+                 help='Student config override, repeatable (same semantics '
+                 'as train --set; applied before finalize_params).')
 
 
 def _add_calibrate(sub):
@@ -331,14 +348,7 @@ def _dispatch(args) -> int:
     from deepconsensus_tpu.parallel import mesh as mesh_lib
 
     params = config_lib.get_config(args.config)
-    # Overrides apply before finalize_params so derived values
-    # (total_rows, hidden_size) see them.
-    with params.unlocked():
-      for item in args.overrides:
-        key, eq, raw = item.partition('=')
-        if not eq or not hasattr(params, key):
-          raise ValueError(f'unknown config override {item!r}')
-        setattr(params, key, _coerce_override(raw, getattr(params, key)))
+    _apply_overrides(params, args.overrides)
     config_lib.finalize_params(params)
     with params.unlocked():
       if args.batch_size:
@@ -407,24 +417,21 @@ def _dispatch(args) -> int:
     return 0
 
   if args.command == 'distill':
-    import jax.numpy as jnp
-
     from deepconsensus_tpu.models.checkpoints import load_params
     from deepconsensus_tpu.models import config as config_lib
     from deepconsensus_tpu.models import distill as distill_lib
-    from deepconsensus_tpu.models import model as model_lib
 
     teacher_params = config_lib.read_params_from_json(
         args.teacher_checkpoint
     )
     config_lib.finalize_params(teacher_params)
-    teacher = model_lib.get_model(teacher_params)
-    rows = jnp.zeros(
-        (1, teacher_params.total_rows, teacher_params.max_length, 1)
-    )
     teacher_weights = load_params(args.teacher_checkpoint)
     student_params = config_lib.get_config(args.config)
+    _apply_overrides(student_params, args.overrides)
     config_lib.finalize_params(student_params)
+    if args.batch_size:
+      with student_params.unlocked():
+        student_params.batch_size = args.batch_size
     distill_lib.run_distillation(
         params=student_params,
         teacher_params_cfg=teacher_params,
