@@ -167,3 +167,40 @@ def test_auto_unroll_respects_vmem_budget():
   assert fwd * per_diag_fwd <= wp._VMEM_STREAM_BUDGET
   # Never below 1, even for absurd shapes.
   assert wp._auto_unroll(8, 1 << 20, 6 * 512 + 4) == 1
+
+
+def test_unroll_invariance(monkeypatch):
+  """Scores and gradients are bit-identical in expectation across
+  unroll factors (the block padding/masking algebra must not leak into
+  values for any unroll choice)."""
+  import jax
+
+  from deepconsensus_tpu.ops import wavefront_pallas as wp
+
+  rng = np.random.default_rng(11)
+  b, m, n = 4, 9, 7
+  subs = jnp.asarray(rng.normal(size=(b, m, n)).astype(np.float32))
+  ins = jnp.asarray(rng.normal(size=(b, n)).astype(np.float32))
+  lens = jnp.asarray(rng.integers(3, m + 1, size=(b,)), jnp.int32)
+
+  base = wp.alignment_scores(subs, ins, 2.0, lens, loss_reg=0.5,
+                             interpret=True, unroll=1)
+  for unroll in (2, 3, 8):
+    got = wp.alignment_scores(subs, ins, 2.0, lens, loss_reg=0.5,
+                              interpret=True, unroll=unroll)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                               rtol=1e-6, atol=1e-6)
+
+  def loss(u):
+    def f(s, i):
+      monkeypatch.setattr(wp, 'PALLAS_UNROLL', u)
+      return jnp.sum(wp.alignment_scores_vjp(s, i, lens, 2.0, 0.5,
+                                             interpret=True))
+    return jax.grad(f, argnums=(0, 1))(subs, ins)
+
+  g1 = loss(1)
+  for u in (3, 8):
+    gu = loss(u)
+    for want, got in zip(g1, gu):
+      np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                 rtol=1e-5, atol=1e-6)
