@@ -17,6 +17,12 @@ def load_params(checkpoint_path: str, params_template=None):
   keys, so the whole tree restores untyped and the params subtree is
   selected; this trades peak host memory (optimizer moments load too)
   for format independence.
+
+  With params_template, the restored tree is validated against the
+  template's structure and leaf shapes (clear restore-time error
+  instead of a delayed flax scope failure) and each leaf is cast to
+  the template's dtype (a bf16-saved checkpoint warm-starting an f32
+  run must not silently flip the training dtype).
   """
   import orbax.checkpoint as ocp
 
@@ -27,7 +33,28 @@ def load_params(checkpoint_path: str, params_template=None):
         f'checkpoint {checkpoint_path!r} has no params tree; '
         f'keys: {list(restored)}'
     )
-  return restored['params']
+  params = restored['params']
+  if params_template is not None:
+    import jax
+
+    t_struct = jax.tree.structure(params_template)
+    r_struct = jax.tree.structure(params)
+    if t_struct != r_struct:
+      raise ValueError(
+          f'checkpoint {checkpoint_path!r} params tree does not match '
+          f'the model: saved {r_struct}, expected {t_struct}'
+      )
+
+    def _adopt(t, r):
+      if hasattr(t, 'shape') and tuple(t.shape) != tuple(r.shape):
+        raise ValueError(
+            f'checkpoint {checkpoint_path!r} leaf shape {tuple(r.shape)} '
+            f'does not match the model\'s {tuple(t.shape)}'
+        )
+      return r.astype(t.dtype) if hasattr(t, 'dtype') else r
+
+    params = jax.tree.map(_adopt, params_template, params)
+  return params
 
 
 def load_full_state(checkpoint_path: str) -> Dict[str, Any]:

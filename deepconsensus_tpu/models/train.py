@@ -371,10 +371,16 @@ class Trainer:
     and LR-schedule position (the reference restores the whole
     tf.train.Checkpoint: model_utils.py:511-540)."""
     if params_only:
-      restored = self._checkpointer.restore(
-          path, target={'params': jax.device_get(state.params)},
-      )
-      return state.replace(params=restored['params'])
+      # Warm-start source checkpoints are usually full TrainStates
+      # (params + opt_state + step); a params-only typed target makes
+      # orbax raise a structure mismatch, so select the subtree from
+      # an untyped restore (same approach as checkpoints.load_params,
+      # which inference/export use). The template keeps restore-time
+      # structure/shape validation and casts to the model's dtype.
+      from deepconsensus_tpu.models.checkpoints import load_params
+
+      return state.replace(params=load_params(
+          path, params_template=jax.device_get(state.params)))
     restored = self._checkpointer.restore(
         path,
         target={

@@ -203,3 +203,41 @@ def test_pool_worker_never_raises_and_leaks_nothing(tmp_path):
   assert status == 'error'
   assert 'Traceback' in payload
   assert set(glob.glob('/dev/shm/*')) == before
+
+
+def test_warm_start_into_fresh_dir_from_full_checkpoint(
+    tmp_path, testdata_dir):
+  """Warm-starting a FRESH run from a full TrainState checkpoint
+  (params + opt_state + step, what Trainer.save_checkpoint writes)
+  must restore the params subtree rather than raising an orbax
+  structure mismatch on the extra collections."""
+  params = tiny_params()
+  src_dir = str(tmp_path / 'teacher_run')
+  patterns = [str(testdata_dir / 'human_1m/tf_examples/eval/*')]
+  train_lib.run_training(
+      params=params, out_dir=src_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=1, eval_every=10**9,
+  )
+  def _step(name):
+    try:
+      return int(name.split('-')[1])
+    except (IndexError, ValueError):  # orbax tmp dirs etc.
+      return None
+
+  ckpt_dir = os.path.join(src_dir, 'checkpoints')
+  last = max(
+      s for s in (_step(n) for n in os.listdir(ckpt_dir)
+                  if n.startswith('checkpoint-'))
+      if s is not None
+  )
+  warm = os.path.join(ckpt_dir, f'checkpoint-{last}')
+
+  fresh_dir = str(tmp_path / 'warm_fresh')
+  train_lib.run_training(
+      params=params, out_dir=fresh_dir,
+      train_patterns=patterns, eval_patterns=patterns,
+      num_epochs=1, eval_every=10**9, warm_start=warm,
+  )
+  fresh_ckpts = os.listdir(os.path.join(fresh_dir, 'checkpoints'))
+  assert any(n.startswith('checkpoint-') for n in fresh_ckpts)
