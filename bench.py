@@ -187,6 +187,15 @@ def main():
 
   if cpu_fallback:
     jax.config.update('jax_platforms', 'cpu')
+  elif jax.default_backend() == 'cpu':
+    # A clean plugin failure falls back to the CPU backend silently.
+    # A TPU-labeled child must never measure a CPU: its unmarked lines
+    # would override an honest 'CPU FALLBACK'-labeled number already on
+    # stdout (the driver keeps the LAST parseable line). Die metric-less
+    # instead; the parent falls back / keeps the CPU result.
+    sys.stderr.write('bench child: expected TPU backend, got cpu; '
+                     'refusing to emit mislabeled metrics\n')
+    sys.exit(3)
   from deepconsensus_tpu.models.train import enable_compilation_cache
 
   enable_compilation_cache()  # retried rounds pay each compile once
@@ -591,11 +600,59 @@ def _run_child(env, watchdog_secs: float) -> Tuple[int, bool]:
   return proc.returncode, saw_metric[0]
 
 
+# CPU-fallback child cap: forward b256 + host featurization finish
+# well inside this, and capping it leaves the tail of the budget for
+# the late TPU retry below.
+CPU_CHILD_CAP_SECS = 420
+# A late TPU upgrade needs a probe plus a child long enough to emit at
+# least the b256 forward line (~compile + measure): probes are capped
+# so at least LATE_CHILD_MIN_SECS remains for the child afterwards,
+# and the loop stops once even a minimal probe+child can't fit.
+LATE_CHILD_MIN_SECS = 160
+LATE_RETRY_MIN_SECS = LATE_CHILD_MIN_SECS + 30
+
+
+def _late_tpu_upgrade(env, left) -> None:
+  """After the honest CPU number is on stdout, spend the remaining
+  budget re-probing the chip: the tunnel's observed failure mode is
+  'hangs once, recovers within minutes' (it did exactly that in r2 —
+  BENCH_r02 fell back to CPU with a live chip minutes later). If a
+  late probe succeeds, run the TPU child so its metric lines land
+  AFTER the CPU ones — the driver keeps the LAST parseable line, so
+  even a partial TPU run upgrades the primary result, and a hung TPU
+  child leaves the CPU number standing."""
+  attempt = 0
+  while left() > LATE_RETRY_MIN_SECS:
+    attempt += 1
+    # Never let a (possibly hanging) probe eat the child's minimum.
+    probe_secs = min(PROBE_ATTEMPT_SECS, int(left() - LATE_CHILD_MIN_SECS))
+    if probe_secs < 10:
+      return
+    if _tpu_alive(timeout_secs=probe_secs):
+      # The child's self-budget is watchdog-40 (margin to exit before
+      # the SIGKILL); both must cover the documented minimum.
+      watchdog = left() - 20
+      if watchdog - 40 < LATE_CHILD_MIN_SECS:
+        return  # probe ran long; too little left for a useful child
+      sys.stderr.write(
+          f'bench: late TPU probe ok (attempt {attempt}); upgrading\n')
+      tpu_env = dict(env)
+      tpu_env.pop('DC_BENCH_CPU', None)
+      tpu_env['DC_BENCH_CHILD_BUDGET'] = str(int(max(60, watchdog - 40)))
+      _run_child(tpu_env, watchdog)
+      return
+    sys.stderr.write(f'bench: late TPU probe failed (attempt {attempt})\n')
+    if left() > LATE_RETRY_MIN_SECS + PROBE_PAUSE_SECS:
+      time.sleep(PROBE_PAUSE_SECS)
+
+
 def supervised_main():
   """Parent: probe the chip with retries, then run the bench in a child
   process group hard-killed on timeout (backend hangs sit in blocking C
   calls; signals can't help). Falls back to a CPU child only after the
-  whole probe phase fails AND/OR the TPU child produced nothing."""
+  whole probe phase fails AND/OR the TPU child produced nothing — and
+  after the CPU child delivers its honest number, any remaining budget
+  goes to re-probing the chip to upgrade the result (VERDICT r3 #2)."""
   t0 = time.monotonic()
   left = lambda: TOTAL_BUDGET_SECS - (time.monotonic() - t0)
   env = dict(os.environ)
@@ -611,12 +668,23 @@ def supervised_main():
                      'falling back to CPU\n')
   if left() < 90:
     return _report_failure('TPU backend unresponsive: watchdog timeout', 2)
-  env['DC_BENCH_CPU'] = '1'
-  env['DC_BENCH_CHILD_BUDGET'] = str(int(max(60, left() - 30)))
-  rc, saw_metric = _run_child(env, max(60, left() - 10))
-  if saw_metric:
-    return 0
-  return _report_failure('bench failed on TPU and CPU fallback', 2)
+  cpu_env = dict(env)
+  cpu_env['DC_BENCH_CPU'] = '1'
+  cpu_budget = max(60, min(left() - 30, CPU_CHILD_CAP_SECS))
+  cpu_env['DC_BENCH_CHILD_BUDGET'] = str(int(cpu_budget))
+  rc, saw_metric = _run_child(cpu_env, cpu_budget + 20)
+  if not saw_metric and left() > 90:
+    # The cap exists to bank budget for the late TPU retry; if the
+    # capped child couldn't finish (slow host, cold compile cache),
+    # spend that bank on an uncapped CPU retry instead of failing with
+    # budget in hand.
+    cpu_budget = max(60, left() - 30)
+    cpu_env['DC_BENCH_CHILD_BUDGET'] = str(int(cpu_budget))
+    rc, saw_metric = _run_child(cpu_env, cpu_budget + 20)
+  if not saw_metric:
+    return _report_failure('bench failed on TPU and CPU fallback', 2)
+  _late_tpu_upgrade(env, left)
+  return 0
 
 
 if __name__ == '__main__':

@@ -174,6 +174,9 @@ def _add_export(sub):
                  'artifact metadata. The export is batch-polymorphic '
                  '(serves any batch size) unless symbolic export fails, '
                  'in which case this size is baked in.')
+  p.add_argument('--strict_polymorphic', action='store_true',
+                 help='Fail instead of falling back to a fixed-batch '
+                 'artifact when batch-polymorphic export fails.')
 
 
 def _add_distill(sub):
@@ -412,6 +415,7 @@ def _dispatch(args) -> int:
         checkpoint_path=args.checkpoint,
         out_dir=args.output,
         batch_size=args.batch_size,
+        strict_polymorphic=args.strict_polymorphic,
     )
     print(f'exported: {artifact}')
     return 0

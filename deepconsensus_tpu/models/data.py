@@ -337,17 +337,38 @@ class StreamingDataset:
       )
       proc.start()
       procs.append(proc)
+    def check_liveness():
+      # A worker that died cleanly (exit 0) simply exhausted its
+      # repeat-forever stream early — impossible in practice, so treat
+      # ANY dead worker with a nonzero code as fatal: letting training
+      # continue on the survivors' shard subsets silently skews the
+      # data distribution. Checked on EVERY drain iteration, not just
+      # when the queue runs dry — survivors can keep the queue fed
+      # forever, which is exactly the silent-skew case.
+      crashed = [
+          (w, p.exitcode)
+          for w, p in enumerate(procs)
+          if not p.is_alive() and p.exitcode not in (0, None)
+      ]
+      if crashed:
+        raise RuntimeError(
+            f'StreamingDataset worker(s) crashed: {crashed} of '
+            f'{n_workers}; check shard paths/integrity (corrupt shard '
+            f'or OOM)'
+        )
+      if not any(p.is_alive() for p in procs):
+        codes = [p.exitcode for p in procs]
+        raise RuntimeError(
+            f'all {n_workers} StreamingDataset workers exited '
+            f'(exit codes {codes}); check shard paths/integrity'
+        )
+
     try:
       while not stop.is_set():
+        check_liveness()
         try:
           chunk = out_queue.get(timeout=5)
         except queue_lib.Empty:
-          if not any(p.is_alive() for p in procs):
-            codes = [p.exitcode for p in procs]
-            raise RuntimeError(
-                f'all {n_workers} StreamingDataset workers exited '
-                f'(exit codes {codes}); check shard paths/integrity'
-            )
           continue
         yield from chunk
     finally:

@@ -31,6 +31,7 @@ def export_model(
     variables: Optional[Dict] = None,
     params: Optional[ml_collections.ConfigDict] = None,
     polymorphic_batch: bool = True,
+    strict_polymorphic: bool = False,
 ) -> str:
   """Exports a serving function rows->softmax; returns artifact path.
 
@@ -38,8 +39,17 @@ def export_model(
   artifact serves ANY batch size (the reference's SavedModel does
   this; a fixed-batch artifact was the round-2 limitation).
   batch_size is kept in the metadata as the recommended serving batch.
-  Falls back to a fixed-batch export if symbolic export fails.
+  Falls back to a fixed-batch export if symbolic export fails — unless
+  strict_polymorphic, which re-raises so automated pipelines cannot
+  silently ship an artifact that rejects every batch size but the
+  baked one. The fallback is always surfaced in export_meta.json's
+  `polymorphic_batch` field; callers that require a polymorphic
+  artifact should assert on it (see load_exported).
   """
+  if strict_polymorphic and not polymorphic_batch:
+    raise ValueError(
+        'strict_polymorphic=True requires polymorphic_batch=True (a '
+        'fixed-batch export can never satisfy the strict guarantee).')
   if params is None:
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
@@ -66,6 +76,11 @@ def export_model(
       )
       is_polymorphic = True
     except Exception as e:  # pragma: no cover - model not batch-polymorphic
+      if strict_polymorphic:
+        raise RuntimeError(
+            'Batch-polymorphic export failed and strict_polymorphic is '
+            'set; refusing to fall back to a fixed-batch artifact.'
+        ) from e
       logging.warning(
           'Batch-polymorphic export failed (%s: %s); falling back to a '
           'fixed-batch artifact that only serves batch_size=%d.',

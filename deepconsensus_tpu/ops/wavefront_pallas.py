@@ -393,14 +393,15 @@ def _bwd_kernel(subs_ref, ins_ref, rows_p2_ref, rows_p1_ref, lens_ref,
 
 
 def _scores_fwd_impl(subs_costs, ins_costs, seq_lens, del_cost, loss_reg,
-                     inf, interpret, emit_rows=False):
+                     inf, interpret, emit_rows=False, unroll=None):
   return _scores_and_rows(
       subs_costs, ins_costs, del_cost, seq_lens, loss_reg, inf,
       pallas_util.resolve_interpret(interpret), emit_rows=emit_rows,
+      unroll=unroll,
   )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def alignment_scores_vjp(
     subs_costs: Array,
     ins_costs: Array,
@@ -409,21 +410,26 @@ def alignment_scores_vjp(
     loss_reg: Optional[float],
     inf: float = 1e9,
     interpret: Optional[bool] = None,
+    unroll: Optional[int] = None,
 ) -> Array:
   """Differentiable Pallas twin of wavefront.alignment_scan.
 
   Same scores as `alignment_scores`; gradients w.r.t. subs_costs and
-  ins_costs come from the pipelined backward kernels.
+  ins_costs come from the pipelined backward kernels. `unroll` caps the
+  per-grid-step diagonal unroll for both sweeps (None = PALLAS_UNROLL;
+  the VMEM fit still applies, so forward and backward may resolve to
+  different effective unrolls — results are unroll-invariant either
+  way).
   """
   out, _ = _scores_fwd_impl(
       subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-      interpret,
+      interpret, unroll=unroll,
   )
   return out
 
 
 def _vjp_fwd(subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-             interpret):
+             interpret, unroll):
   # Run the forward with emit_rows=True and save every DP row V[k] as
   # a residual: the backward then starts directly at the reverse
   # adjoint sweep instead of re-running the whole forward DP (one of
@@ -434,12 +440,12 @@ def _vjp_fwd(subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
   # wavefrontified views (a cheap XLA gather next to the DP sweep).
   out, rows_kernel = _scores_fwd_impl(
       subs_costs, ins_costs, seq_lens, del_cost, loss_reg, inf,
-      interpret, emit_rows=True,
+      interpret, emit_rows=True, unroll=unroll,
   )
   return out, (subs_costs, ins_costs, seq_lens, rows_kernel)
 
 
-def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
+def _vjp_bwd(del_cost, loss_reg, inf, interpret, unroll, res, g):
   import numpy as np
 
   subs_costs, ins_costs, seq_lens, rows_kernel = res
@@ -462,7 +468,9 @@ def _vjp_bwd(del_cost, loss_reg, inf, interpret, res, g):
   # the kernel walks u descending inside it.
   # Backward streams 6 [unroll, B, ~m] blocks per diagonal (4 in,
   # 2 out), so the VMEM-fitted unroll is smaller than the forward's.
-  unroll = _auto_unroll(PALLAS_UNROLL, batch, 6 * m + 4)
+  unroll = _auto_unroll(
+      PALLAS_UNROLL if unroll is None else unroll, batch, 6 * m + 4
+  )
   unroll = max(1, min(unroll, k_dim))
   n_blocks = -(-k_dim // unroll)
   n_pad = n_blocks * unroll

@@ -36,3 +36,69 @@ def test_forward_line_units_are_honest():
   assert 'NOT forward-to-forward' in line['unit']
   cpu = bench._forward_line(40.0, 256, cpu_fallback=True)
   assert 'CPU FALLBACK' in cpu['unit']
+
+
+def test_tpu_child_refuses_cpu_backend():
+  """A TPU-labeled child on a CPU backend must die without emitting a
+  metric line: its unmarked numbers would override an honest CPU
+  FALLBACK line (the driver keeps the LAST parseable line)."""
+  import os
+  import subprocess
+
+  env = dict(os.environ)
+  env.pop('DC_BENCH_CPU', None)
+  env['JAX_PLATFORMS'] = 'cpu'
+  # The axon plugin ignores JAX_PLATFORMS and hangs on a dead tunnel;
+  # keep it off the child's path so the backend resolves to cpu.
+  repo_dir = os.path.dirname(os.path.abspath(bench.__file__))
+  env['PYTHONPATH'] = ':'.join(
+      [repo_dir] + [p for p in env.get('PYTHONPATH', '').split(':')
+                    if p and p != repo_dir and 'axon' not in p])
+  proc = subprocess.run(
+      [sys.executable, bench.__file__, '--child'],
+      capture_output=True, text=True, env=env, timeout=120)
+  assert proc.returncode == 3
+  assert not any(bench._is_metric_line(l) for l in proc.stdout.splitlines())
+  assert 'refusing to emit mislabeled metrics' in proc.stderr
+
+
+def test_late_tpu_upgrade_runs_tpu_child_when_probe_recovers(monkeypatch):
+  """Once the chip answers a late probe, the TPU child runs WITHOUT the
+  CPU-fallback flag so its metric lines upgrade the CPU number."""
+  probes = []
+  runs = []
+  monkeypatch.setattr(
+      bench, '_tpu_alive',
+      lambda timeout_secs: probes.append(timeout_secs) or len(probes) >= 2)
+  monkeypatch.setattr(
+      bench, '_run_child', lambda env, wd: runs.append((env, wd)) or (0, True))
+  monkeypatch.setattr(bench.time, 'sleep', lambda s: None)
+  bench._late_tpu_upgrade({'DC_BENCH_CPU': '1'}, left=lambda: 600)
+  assert len(probes) == 2  # first probe fails, second succeeds
+  (env, watchdog), = runs
+  assert 'DC_BENCH_CPU' not in env
+  assert watchdog >= 120
+  assert int(env['DC_BENCH_CHILD_BUDGET']) >= 60
+
+
+def test_late_tpu_upgrade_gives_up_without_budget(monkeypatch):
+  """No probe (let alone a child) once the remaining budget cannot fit
+  probe + a useful child run."""
+  monkeypatch.setattr(
+      bench, '_tpu_alive',
+      lambda timeout_secs: (_ for _ in ()).throw(AssertionError('probed')))
+  bench._late_tpu_upgrade({}, left=lambda: bench.LATE_RETRY_MIN_SECS - 1)
+
+
+def test_late_tpu_upgrade_stops_probing_when_chip_stays_dead(monkeypatch):
+  """Failed probes consume wall-clock; the loop must terminate."""
+  clock = [0.0]
+  monkeypatch.setattr(
+      bench, '_tpu_alive',
+      lambda timeout_secs: clock.__setitem__(0, clock[0] + 90) or False)
+  monkeypatch.setattr(
+      bench.time, 'sleep', lambda s: clock.__setitem__(0, clock[0] + s))
+  monkeypatch.setattr(
+      bench, '_run_child',
+      lambda env, wd: (_ for _ in ()).throw(AssertionError('ran child')))
+  bench._late_tpu_upgrade({}, left=lambda: 600 - clock[0])
