@@ -70,6 +70,9 @@ def _add_preprocess(sub):
                  type=_parse_shard,
                  help='Process only ZMWs with zm %% N == I (fleet '
                  'scaling; shard the output paths too).')
+  p.add_argument('--compression', choices=['bgzf', 'gzip'], default='bgzf',
+                 help='.gz shard framing: bgzf (default; valid gzip, '
+                 'parallel-decodable blocks) or single-member gzip.')
 
 
 def _add_run(sub):
@@ -289,6 +292,7 @@ def _dispatch(args) -> int:
         limit=args.limit,
         cpus=args.cpus,
         shard=args.shard,
+        compression=args.compression.upper(),
     )
     return 0
 

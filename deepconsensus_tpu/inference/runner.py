@@ -142,6 +142,20 @@ def _bq_row_index(params) -> Optional[int]:
   return bq_lo
 
 
+def _check_dp_divisible(options: 'InferenceOptions', mesh) -> int:
+  """The compiled batch splits evenly over the mesh data axis; returns
+  the data-axis size."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  dp = mesh.shape[mesh_lib.DATA_AXIS]
+  if options.batch_size % dp:
+    raise ValueError(
+        f'batch_size={options.batch_size} not divisible by the mesh '
+        f'data axis ({dp} devices)'
+    )
+  return dp
+
+
 class ModelRunner:
   """Jitted forward pass producing (bases, quality scores) per window.
 
@@ -159,12 +173,7 @@ class ModelRunner:
     if mesh is not None:
       from deepconsensus_tpu.parallel import mesh as mesh_lib
 
-      dp = mesh.shape[mesh_lib.DATA_AXIS]
-      if options.batch_size % dp:
-        raise ValueError(
-            f'batch_size={options.batch_size} not divisible by the mesh '
-            f'data axis ({dp} devices)'
-        )
+      _check_dp_divisible(options, mesh)
       # Place the weights on the mesh once; otherwise every forward
       # re-broadcasts host arrays to all devices. param_shardings
       # shards attention heads / FFN filters on the model axis under
@@ -222,14 +231,7 @@ class ModelRunner:
     if os.path.isdir(checkpoint_path) and os.path.exists(
         os.path.join(checkpoint_path, export_lib.ARTIFACT_NAME)
     ):
-      # Exported StableHLO artifacts bake in single-device execution.
-      if mesh is not None:
-        raise ValueError(
-            'mesh/--dp is not supported for exported StableHLO '
-            'artifacts (single-device execution is baked in); use an '
-            'orbax checkpoint for multi-chip inference'
-        )
-      return cls.from_exported(checkpoint_path, options)
+      return cls.from_exported(checkpoint_path, options, mesh=mesh)
 
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
@@ -238,8 +240,17 @@ class ModelRunner:
 
   @classmethod
   def from_exported(cls, export_dir: str,
-                    options: InferenceOptions) -> 'ModelRunner':
-    """Serves an exported StableHLO artifact (params baked in)."""
+                    options: InferenceOptions,
+                    mesh=None) -> 'ModelRunner':
+    """Serves an exported StableHLO artifact (params baked in).
+
+    With a mesh, the single-device program serves data-parallel: each
+    device runs the artifact on its batch shard under shard_map (the
+    batch-polymorphic export accepts the per-device shape), matching
+    the reference's any-topology SavedModel serving. Requires a
+    polymorphic artifact and a pure-DP mesh — the baked program can't
+    be re-sharded on the model axis.
+    """
     from deepconsensus_tpu.models import export as export_lib
 
     serving, meta = export_lib.load_exported(export_dir)
@@ -250,20 +261,54 @@ class ModelRunner:
     runner.variables = None
     if not meta.get('polymorphic_batch'):
       # Fixed-batch artifact: the compiled shape wins over the flag.
+      if mesh is not None:
+        raise ValueError(
+            'mesh/--dp serving of an exported artifact requires a '
+            'batch-polymorphic export (this artifact is fixed-batch; '
+            're-export with polymorphic_batch=True)'
+        )
       options.batch_size = int(meta['batch_size'])
     runner.options = options
+    runner.mesh = mesh
     runner._bq_row = _bq_row_index(params)
     bq_row = runner._bq_row
 
-    @jax.jit
-    def forward(_variables, main_u8, sn):
+    def apply_serving(main_u8, sn):
       preds = serving(_assemble_rows(main_u8, sn, bq_row))
       return (
           jnp.argmax(preds, axis=-1).astype(jnp.int32),
           jnp.max(preds, axis=-1),
       )
 
-    runner._forward = forward
+    if mesh is None:
+      runner._forward = jax.jit(
+          lambda _variables, main_u8, sn: apply_serving(main_u8, sn))
+      return runner
+
+    from jax.sharding import PartitionSpec
+    try:
+      from jax import shard_map as shard_map_lib  # jax >= 0.8
+      shard_map = shard_map_lib
+    except ImportError:  # pragma: no cover - older jax
+      from jax.experimental.shard_map import shard_map
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    if mesh_lib.MODEL_AXIS in mesh.shape and (
+        mesh.shape[mesh_lib.MODEL_AXIS] > 1):
+      raise ValueError(
+          'exported artifacts serve data-parallel only (the compiled '
+          'program cannot be re-sharded on the model axis); use tp=1 '
+          'or an orbax checkpoint'
+      )
+    _check_dp_divisible(options, mesh)
+    batch_spec = PartitionSpec(mesh_lib.DATA_AXIS)
+    sharded_serving = shard_map(
+        apply_serving, mesh=mesh,
+        in_specs=(batch_spec, batch_spec),
+        out_specs=(batch_spec, batch_spec),
+    )
+    runner._forward = jax.jit(
+        lambda _variables, main_u8, sn: sharded_serving(main_u8, sn))
     return runner
 
   def dispatch(self, rows: np.ndarray):

@@ -134,20 +134,15 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
   rng = np.random.default_rng(seed)
   pending: List[Dict[str, np.ndarray]] = []
   while True:
-    order = rng.permutation(len(paths))
-    readers = [iter(TFRecordReader(paths[i])) for i in order]
-    while readers:
-      alive = []
-      for reader in readers:
-        try:
-          pending.append(parse_example_minimal(next(reader), inference))
-          alive.append(reader)
-        except StopIteration:
-          continue
+    # One shard at a time (native whole-shard decode: memory per worker
+    # is bounded by its largest shard); the parent's reservoir buffer
+    # plus this per-epoch permutation provide the mixing.
+    for i in rng.permutation(len(paths)):
+      for raw in TFRecordReader(paths[i], native_decode=True):
+        pending.append(parse_example_minimal(raw, inference))
         if len(pending) >= chunk:
           out_queue.put(pending)
           pending = []
-      readers = alive
 
 
 def _batch_from_minimal(
@@ -281,25 +276,18 @@ class StreamingDataset:
     self._rng = np.random.default_rng(self.seed)
 
   def _raw_stream(self) -> Iterator[bytes]:
-    """Round-robin interleave across shards, repeating forever."""
+    """Shards in a fresh random order each epoch, consumed ONE AT A
+    TIME with whole-shard native decode (memory stays bounded by the
+    largest single shard; an interleave across open native readers
+    would hold every shard's records at once). Cross-shard mixing is
+    the reference's shuffle-files + shuffle-buffer recipe: per-epoch
+    shard permutation here, reservoir buffer in __iter__
+    (data_providers.py:395-425)."""
     from deepconsensus_tpu.io.tfrecord import TFRecordReader
 
-    epoch = 0
     while True:
-      order = self._rng.permutation(len(self._paths))
-      readers = [
-          iter(TFRecordReader(self._paths[i])) for i in order
-      ]
-      while readers:
-        alive = []
-        for reader in readers:
-          try:
-            yield next(reader)
-            alive.append(reader)
-          except StopIteration:
-            pass
-        readers = alive
-      epoch += 1
+      for i in self._rng.permutation(len(self._paths)):
+        yield from TFRecordReader(self._paths[i], native_decode=True)
 
   def _minimal_stream(self, stop) -> Iterator[Dict[str, np.ndarray]]:
     """Raw records -> minimal parses, optionally via worker processes.

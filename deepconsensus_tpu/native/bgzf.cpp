@@ -7,6 +7,7 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC bgzf.cpp -o libdcnative.so -lz -lpthread
 
+#include <climits>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -149,6 +150,110 @@ int dc_bgzf_decompress_file(const char* path, int n_threads, uint8_t** out,
 }
 
 void dc_free(uint8_t* ptr) { free(ptr); }
+
+// Whole-buffer inflate for arbitrary (possibly multi-member) gzip —
+// the fallback when a shard is NOT BGZF (plain gzip from the
+// pure-Python writer or the reference's TF writer has one member and
+// no BC field, so the parallel block path can't apply). Serial, but
+// the inflate + framing cost still moves from Python to C.
+int dc_gzip_decompress(const uint8_t* data, size_t len, uint8_t** out,
+                       size_t* out_len) {
+  // avail_in is a uInt; a >=4 GiB input would silently truncate to
+  // len mod 2^32 (possibly decoding a clean prefix and returning 0).
+  if (len > UINT_MAX) return 5;
+  size_t cap = len * 4 + (1 << 16);
+  uint8_t* buffer = (uint8_t*)malloc(cap);
+  if (!buffer) return 2;
+  size_t total = 0;
+
+  z_stream zs;
+  memset(&zs, 0, sizeof(zs));
+  // 15+16: gzip wrapper with max window.
+  if (inflateInit2(&zs, 15 + 16) != Z_OK) {
+    free(buffer);
+    return 4;
+  }
+  zs.next_in = const_cast<uint8_t*>(data);
+  zs.avail_in = (uInt)len;
+  for (;;) {
+    if (total == cap) {
+      cap *= 2;
+      uint8_t* grown = (uint8_t*)realloc(buffer, cap);
+      if (!grown) {
+        inflateEnd(&zs);
+        free(buffer);
+        return 2;
+      }
+      buffer = grown;
+    }
+    zs.next_out = buffer + total;
+    zs.avail_out = (uInt)(cap - total);
+    const int ret = inflate(&zs, Z_NO_FLUSH);
+    total = cap - zs.avail_out;
+    if (ret == Z_STREAM_END) {
+      if (zs.avail_in == 0) break;
+      // Concatenated member: restart on the remaining input.
+      if (inflateReset2(&zs, 15 + 16) != Z_OK) {
+        inflateEnd(&zs);
+        free(buffer);
+        return 4;
+      }
+      continue;
+    }
+    if (ret != Z_OK) {
+      inflateEnd(&zs);
+      free(buffer);
+      return 3;
+    }
+  }
+  inflateEnd(&zs);
+  *out = buffer;
+  *out_len = total;
+  return 0;
+}
+
+// Parses TFRecord framing (u64 length, u32 len-crc, payload, u32
+// payload-crc) over a decompressed buffer. Emits (offset, length)
+// pairs of the PAYLOADS into a malloc'd u64 array (caller frees via
+// dc_free). CRCs are not validated (matching the Python reader's
+// check_crc=False default); framing errors return nonzero.
+int dc_tfrecord_index(const uint8_t* data, size_t len, uint64_t** pairs,
+                      size_t* n_records) {
+  size_t cap = 1024;
+  uint64_t* out = (uint64_t*)malloc(cap * 2 * sizeof(uint64_t));
+  if (!out) return 2;
+  size_t n = 0;
+  size_t pos = 0;
+  while (pos < len) {
+    if (pos + 12 > len) {
+      free(out);
+      return 1;  // truncated header
+    }
+    uint64_t rec_len;
+    memcpy(&rec_len, data + pos, 8);  // little-endian hosts only (x86/ARM)
+    const size_t payload = pos + 12;
+    if (rec_len > len || payload + rec_len + 4 > len) {
+      free(out);
+      return 1;  // truncated payload
+    }
+    if (n == cap) {
+      cap *= 2;
+      uint64_t* grown = (uint64_t*)realloc(out, cap * 2 * sizeof(uint64_t));
+      if (!grown) {
+        free(out);
+        return 2;
+      }
+      out = grown;
+    }
+    out[2 * n] = payload;
+    out[2 * n + 1] = rec_len;
+    ++n;
+    pos = payload + rec_len + 4;
+  }
+  *pairs = out;
+  *n_records = n;
+  return 0;
+}
 
 // crc32c (Castagnoli), software table implementation, for TFRecord
 // framing without per-byte Python cost.

@@ -43,12 +43,18 @@ def run_preprocess(
     limit: int = 0,
     cpus: int = 0,
     shard: Optional[tuple] = None,
+    compression: str = 'BGZF',
 ) -> Dict[str, int]:
   """Writes examples to `output` ('@split' expands per split).
 
   Returns the combined counter. With cpus>0 featurization fans out to a
   process pool while the main process remains the single writer
   (reference: preprocess.py:297-332).
+
+  compression: 'BGZF' (default) writes .gz shards as BGZF blocks —
+  still valid gzip for any TFRecord reader, and the training loader's
+  native decode path can inflate the blocks in parallel. 'GZIP' writes
+  a single-member stream like the reference's TF writer.
   """
   is_training = bool(truth_bed and truth_to_ccs and truth_split)
   splits = ('train', 'eval', 'test') if is_training else ('inference',)
@@ -73,7 +79,8 @@ def run_preprocess(
   for split in splits:
     path = output.replace('@split', split)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    writers[split] = TFRecordWriter(path)
+    writers[split] = TFRecordWriter(
+        path, compression=compression if path.endswith('.gz') else None)
 
   agg: collections.Counter = collections.Counter()
 

@@ -79,3 +79,44 @@ def test_fixed_export_pins_batch_size(tmp_path):
   options = runner_lib.InferenceOptions(batch_size=64)
   runner_lib.ModelRunner.from_exported(export_dir, options)
   assert options.batch_size == 32  # adopted from export meta
+
+
+def test_exported_serves_on_dp_mesh(tmp_path):
+  """A polymorphic artifact serves data-parallel on a mesh (each device
+  runs the baked program on its batch shard), byte-matching the
+  single-device runner — including a padded partial batch."""
+  import pytest
+
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  if len(jax.devices()) < 8:
+    pytest.skip('needs the 8-device virtual mesh')
+  params, _, _, export_dir = tiny_export(tmp_path)
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  options = runner_lib.InferenceOptions(batch_size=64)
+  single = runner_lib.ModelRunner.from_exported(export_dir, options)
+  sharded = runner_lib.ModelRunner.from_checkpoint(
+      export_dir, options, mesh=mesh)
+  rng = np.random.default_rng(1)
+  for n in (64, 37):  # full + partial (padded to 64, split over dp)
+    rows = rng.integers(
+        0, 4, size=(n, params.total_rows, params.max_length, 1)
+    ).astype(np.float32)
+    ids_s, q_s = single.predict(rows)
+    ids_m, q_m = sharded.predict(rows)
+    assert np.array_equal(ids_s, ids_m)
+    assert np.array_equal(q_s, q_m)
+
+
+def test_fixed_export_rejects_mesh(tmp_path):
+  import pytest
+
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  if len(jax.devices()) < 2:
+    pytest.skip('needs multiple devices')
+  _, _, _, export_dir = tiny_export(tmp_path, polymorphic=False)
+  mesh = mesh_lib.make_mesh(tp=1, devices=jax.devices()[:2])
+  with pytest.raises(ValueError, match='batch-polymorphic'):
+    runner_lib.ModelRunner.from_exported(
+        export_dir, runner_lib.InferenceOptions(batch_size=64), mesh=mesh)
