@@ -7,6 +7,30 @@ scripts/bench_train_stages.py cannot drift apart.
 """
 
 
+def make_rows(params, batch, seed=2, rng=None):
+  """Synthetic [B, R, L, 1] pileup rows with per-feature-valid ranges
+  (the stacked layout models/data.py produces). Pass `rng` to draw
+  from a caller-owned stream (keeps downstream draws — e.g. labels —
+  on the same stream across refactors, so bench loss values stay
+  comparable between rounds)."""
+  import numpy as np
+
+  if rng is None:
+    rng = np.random.default_rng(seed)
+  rows = np.zeros(
+      (batch, params.total_rows, params.max_length, 1), np.float32)
+  mp = params.max_passes
+  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)  # bases
+  rows[:, mp:3 * mp] = rng.integers(  # pw, ip
+      0, 256, size=rows[:, mp:3 * mp].shape)
+  rows[:, 3 * mp:4 * mp] = rng.integers(  # strand
+      0, 3, size=rows[:, 3 * mp:4 * mp].shape)
+  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)  # ccs
+  rows[:, 4 * mp + 1:] = rng.integers(  # sn
+      0, 501, size=rows[:, 4 * mp + 1:].shape)
+  return rows
+
+
 def make_trainer_and_batch(batch, use_scan_dp=False,
                            out_dir='/tmp/dc_bench_train'):
   """Returns (trainer, state, rows_t, label) for the test config at
@@ -25,18 +49,10 @@ def make_trainer_and_batch(batch, use_scan_dp=False,
   trainer = train_lib.Trainer(params=tp, out_dir=out_dir, mesh=None)
   state = trainer.init_state(steps_total=100)
 
+  # One stream for rows THEN label, matching the pre-refactor draw
+  # order bit-for-bit (round-2/3 measured loss values diff cleanly).
   rng = np.random.default_rng(2)
-  rows = np.zeros((batch, tp.total_rows, tp.max_length, 1), np.float32)
-  mp = tp.max_passes
-  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)  # bases
-  rows[:, mp:3 * mp] = rng.integers(  # pw, ip
-      0, 256, size=rows[:, mp:3 * mp].shape)
-  rows[:, 3 * mp:4 * mp] = rng.integers(  # strand
-      0, 3, size=rows[:, 3 * mp:4 * mp].shape)
-  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)  # ccs
-  rows[:, 4 * mp + 1:] = rng.integers(  # sn
-      0, 501, size=rows[:, 4 * mp + 1:].shape)
-  rows_t = jnp.asarray(rows)
+  rows_t = jnp.asarray(make_rows(tp, batch, rng=rng))
   label = jnp.asarray(
       rng.integers(0, 5, size=(batch, tp.max_length)), jnp.int32)
   return trainer, state, rows_t, label

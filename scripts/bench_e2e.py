@@ -31,6 +31,11 @@ def main():
   ap.add_argument('--repeats', type=int, default=8)
   ap.add_argument('--cpus', type=int, default=0)
   ap.add_argument('--batch_size', type=int, default=1024)
+  ap.add_argument('--depth', type=int, default=8,
+                  help='dispatch pipeline depth (batches in flight; '
+                  'r2 measured 4.78 s/batch of tunnel round-trip at '
+                  'depth 1 — sweep this on hardware)')
+  ap.add_argument('--batch_zmws', type=int, default=100)
   ap.add_argument('--cpu', action='store_true', help='force CPU backend')
   args = ap.parse_args()
   if args.repeats < 1:
@@ -51,7 +56,8 @@ def main():
   rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
   variables = model.init(jax.random.PRNGKey(0), rows)
   options = runner_lib.InferenceOptions(
-      batch_size=args.batch_size, batch_zmws=100, cpus=args.cpus,
+      batch_size=args.batch_size, batch_zmws=args.batch_zmws,
+      cpus=args.cpus, dispatch_depth=args.depth,
       min_quality=0,  # untrained weights: keep the writer path honest
   )
   runner = runner_lib.ModelRunner(params, variables, options)
@@ -89,7 +95,10 @@ def main():
       'metric': 'e2e_inference_zmw_per_sec',
       'value': round(n_zmws / elapsed, 2),
       'unit': (f'ZMW/s e2e (backend={jax.default_backend()}, '
-               f'cpus={args.cpus}, {os.cpu_count()} host cores)'),
+               f'cpus={args.cpus}, depth={args.depth}, '
+               f'{os.cpu_count()} host cores)'),
+      'dispatch_depth': args.depth,
+      'batch_zmws': args.batch_zmws,
       'vs_baseline': round(n_zmws / elapsed / REFERENCE_ZMW_PER_SEC, 1),
       'windows_per_sec': round(n_windows / elapsed, 1),
       'stage_seconds': {k: round(v, 2) for k, v in sorted(totals.items())},
