@@ -41,6 +41,12 @@ def main(argv=None):
   ap.add_argument('--ccs_bam', required=True)
   ap.add_argument('--truth_to_ccs', required=True)
   ap.add_argument('--json', default=None)
+  ap.add_argument('--yield_csv', default=None,
+                  help='also write the reference-style yield@emQ table '
+                  '(calibration.yield_metrics.yield_at_thresholds: per '
+                  'predicted-Q threshold, reads kept and bases in reads '
+                  'with empirical identity >= 0.999) for the polished '
+                  'reads AND the raw CCS baseline, to this CSV')
   args = ap.parse_args(argv)
 
   from deepconsensus_tpu.io import bam as bam_lib
@@ -56,10 +62,13 @@ def main(argv=None):
       continue
     if rec.reference_name is not None and rec.seq:
       truth_by_ccs_name[rec.reference_name] = rec.seq
-  ccs_by_name = {
-      rec.qname: rec.seq for rec in bam_lib.BamReader(args.ccs_bam)
-      if not (rec.is_supplementary or rec.is_secondary)
-  }
+  ccs_by_name = {}
+  ccs_quals_by_name = {}
+  for rec in bam_lib.BamReader(args.ccs_bam):
+    if rec.is_supplementary or rec.is_secondary:
+      continue
+    ccs_by_name[rec.qname] = rec.seq
+    ccs_quals_by_name[rec.qname] = rec.quals
   polished = {
       name: (seq, qual) for name, seq, qual in fastx.read_fastq(
           args.polished)
@@ -110,6 +119,73 @@ def main(argv=None):
   if args.json:
     with open(args.json, 'w') as f:
       json.dump({'summary': summary, 'per_read': rows}, f, indent=1)
+
+  if args.yield_csv:
+    # The reference's yield@emQ statistic on the bundled truth set,
+    # via the same yield_at_thresholds the aligned-BAM tool uses
+    # (reference docs/yield_metrics.md:80-98: Q-filter on PREDICTED
+    # avg quality, then bases in reads with empirical identity >=
+    # 0.999). Identity here is 1 - d/max(|read|, |truth|) from the
+    # Levenshtein distance — the denominator is a lower bound on the
+    # alignment length, so the identity (and the yield) is
+    # conservative; at the <=0.001 error scale the bar tests, the
+    # difference from an aligner's matches/alignment_length is
+    # negligible. The whole edit budget is recorded under
+    # `mismatches` (no backtrack; only identity feeds the yield bar).
+    import csv as csv_lib
+
+    import numpy as np
+
+    from deepconsensus_tpu.calibration import yield_metrics as ym
+
+    from deepconsensus_tpu import constants
+
+    def assessment(name, seq, avg_q, truth):
+      # Strip the codebase gap token the same way edit_distance does,
+      # so numerator and denominator see identical sequences.
+      seq_nogap = seq.replace(constants.GAP, '')
+      truth_nogap = truth.replace(constants.GAP, '')
+      d = analysis.edit_distance(seq_nogap, truth_nogap)
+      aligned = max(len(seq_nogap), len(truth_nogap))
+      return ym.ReadAssessment(
+          name=name, length=len(seq_nogap), avg_quality=avg_q,
+          matches=aligned - d, mismatches=d, insertions=0, deletions=0)
+
+    tables = {}
+    for label, reads in (
+        ('polished', [
+            assessment(
+                name, seq,
+                phred.avg_phred(phred.quality_string_to_array(qual)),
+                truth_by_ccs_name[name])
+            for name, (seq, qual) in sorted(polished.items())
+            if name in truth_by_ccs_name
+        ]),
+        ('ccs', [
+            assessment(
+                name, ccs_by_name[name],
+                # quals is None for the BAM 0xFF no-quality sentinel
+                # (same guard as yield_metrics.assess_read).
+                phred.avg_phred(
+                    ccs_quals_by_name[name]
+                    if ccs_quals_by_name[name] is not None
+                    else np.empty(0)),
+                truth)
+            for name, truth in sorted(truth_by_ccs_name.items())
+            if name in ccs_by_name
+        ]),
+    ):
+      tables[label] = ym.yield_at_thresholds(reads)
+    with open(args.yield_csv, 'w', newline='') as f:
+      writer = csv_lib.DictWriter(
+          f, fieldnames=['reads'] + list(tables['polished'][0].keys()))
+      writer.writeheader()
+      for label, table in tables.items():
+        for row in table:
+          writer.writerow({'reads': label, **row})
+    print(json.dumps({'yield_csv': args.yield_csv, **{
+        f'{label}_yield_at_q{row["quality_threshold"]}': row['yield_bases']
+        for label, table in tables.items() for row in table}}))
   return 0
 
 

@@ -29,9 +29,17 @@ run_stage() {  # name timeout_s cmd...
     echo "stage $name FAILED rc=$? (see $OUT/$name.err)"
     # Keep any JSON lines the stage finished before hanging — losing
     # b1024 because b2048 hit a tunnel hang defeats the sweep's point.
-    grep -E '^\{' "$OUT/$name.tmp" > "$OUT/$name.jsonl" 2>/dev/null || true
-    [ -s "$OUT/$name.jsonl" ] && echo "  (kept partial results)" \
-      || rm -f "$OUT/$name.jsonl"
+    # Never clobber a PREVIOUS run's complete results with an empty or
+    # shorter partial (re-run safety: overwrite only when better).
+    grep -E '^\{' "$OUT/$name.tmp" > "$OUT/$name.partial" 2>/dev/null || true
+    old_n=$(wc -l < "$OUT/$name.jsonl" 2>/dev/null || echo 0)
+    new_n=$(wc -l < "$OUT/$name.partial")
+    if [ "$new_n" -gt "$old_n" ]; then
+      mv "$OUT/$name.partial" "$OUT/$name.jsonl"
+      echo "  (kept $new_n partial result lines)"
+    else
+      rm -f "$OUT/$name.partial"
+    fi
   fi
 }
 

@@ -190,9 +190,11 @@ def test_eval_polished_vs_truth_scoring(tmp_path, testdata_dir,
       f.write(f'@{name}\n{seq}\n+\n{"I" * len(seq)}\n')
 
   report = str(tmp_path / 'report.json')
+  yield_csv = str(tmp_path / 'yield.csv')
   rc = eval_polished_vs_truth.main([
       '--polished', str(fastq), '--ccs_bam', ccs_bam,
       '--truth_to_ccs', truth_bam, '--json', report,
+      '--yield_csv', yield_csv,
   ])
   assert rc == 0
   with open(report) as f:
@@ -202,3 +204,20 @@ def test_eval_polished_vs_truth_scoring(tmp_path, testdata_dir,
     assert row['identity_polished'] == 1.0
     assert row['qv_polished'] >= row['qv_ccs']
     assert row['mean_pred_q'] == 40.0  # 'I' = Q40
+
+  # yield@emQ table (the reference's Q-filter + identity>=0.999 bar):
+  # echo-the-truth reads at Q40 pass every threshold with full bases;
+  # the CCS baseline rows exist for the at-equal-yield comparison.
+  import csv
+
+  with open(yield_csv) as f:
+    yrows = list(csv.DictReader(f))
+  total = sum(len(truths[n]) for n in names)
+  pol = {int(r['quality_threshold']): r for r in yrows
+         if r['reads'] == 'polished'}
+  assert set(pol) == {20, 30, 40}
+  for q, row in pol.items():
+    assert int(row['num_reads']) == len(names)
+    assert int(row['yield_bases']) == total
+    assert float(row['mean_identity']) == 1.0
+  assert any(r['reads'] == 'ccs' for r in yrows)
