@@ -198,7 +198,12 @@ def glob_paths(patterns: Union[str, Iterable[str]]) -> List[str]:
 
 def read_tfrecords(patterns: Union[str, Iterable[str]],
                    check_crc: bool = False) -> Iterator[bytes]:
-  """Yields all serialized records matching the glob pattern(s)."""
+  """Yields all serialized records matching the glob pattern(s).
+
+  Shards are consumed one at a time, so the native whole-shard decode
+  is safe here (bounded by the largest single shard)."""
   for path in glob_paths(patterns):
-    with TFRecordReader(path, check_crc=check_crc) as reader:
+    # The reader itself gates native decode off when check_crc is set.
+    with TFRecordReader(path, check_crc=check_crc,
+                        native_decode=True) as reader:
       yield from reader

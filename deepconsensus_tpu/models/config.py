@@ -262,6 +262,12 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.tp_axis = 'model'
   params.eval_every_n_steps = 3000
   params.log_every_n_steps = 100
+  # Eval metric that selects best_checkpoint.txt (HIGHER is better —
+  # do not point it at eval/loss). The reference pins
+  # eval/per_example_accuracy; on small held-out eval sets that ties
+  # at 0.0 for every checkpoint, so eval/identity_pred is the
+  # useful override there.
+  params.best_checkpoint_metric = 'eval/per_example_accuracy'
 
   params.tpu_scale_factor = 1
 

@@ -163,6 +163,15 @@ class Trainer:
     self._metrics_tsv = os.path.join(self.out_dir, 'checkpoint_metrics.tsv')
     self._best_file = os.path.join(self.out_dir, 'best_checkpoint.txt')
     self._metrics_jsonl = os.path.join(self.out_dir, 'metrics.jsonl')
+    # Which eval metric selects best_checkpoint.txt. The reference pins
+    # per_example_accuracy (whole-window exact match); on small or
+    # held-out eval sets that metric can tie at 0.0 for every
+    # checkpoint (observed on the bundled eval split), so it is
+    # configurable — eval/identity_pred is the right selector
+    # there.
+    self._best_metric_name = self.params.get(
+        'best_checkpoint_metric', constants.MAIN_EVAL_METRIC_NAME
+    ) or constants.MAIN_EVAL_METRIC_NAME
     self._best_metric = -1.0
     self._tsv_columns = None
     # Recover best-metric and header state across restarts.
@@ -170,8 +179,8 @@ class Trainer:
       with open(self._metrics_tsv) as f:
         header = f.readline().strip().split('\t')
         self._tsv_columns = header[1:]
-        if constants.MAIN_EVAL_METRIC_NAME in self._tsv_columns:
-          idx = 1 + self._tsv_columns.index(constants.MAIN_EVAL_METRIC_NAME)
+        if self._best_metric_name in self._tsv_columns:
+          idx = 1 + self._tsv_columns.index(self._best_metric_name)
           for line in f:
             parts = line.strip().split('\t')
             try:
@@ -358,7 +367,14 @@ class Trainer:
           )
           + '\n'
       )
-    main = eval_metrics.get(constants.MAIN_EVAL_METRIC_NAME, -1.0)
+    if self._best_metric_name not in eval_metrics:
+      # A typo'd metric name would otherwise silently never update
+      # best_checkpoint.txt (get() returning -1.0 forever).
+      logging.getLogger(__name__).warning(
+          'best_checkpoint_metric %r not among eval metrics %s; '
+          'best_checkpoint.txt will not update',
+          self._best_metric_name, sorted(eval_metrics))
+    main = eval_metrics.get(self._best_metric_name, -1.0)
     if main > self._best_metric:
       self._best_metric = main
       with open(self._best_file, 'w') as f:

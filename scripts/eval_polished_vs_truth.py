@@ -75,6 +75,7 @@ def main(argv=None):
   }
 
   rows = []
+  dist_cache = {}  # (kind, name) -> edit distance, reused by --yield_csv
   for name, (seq, qual) in sorted(polished.items()):
     truth = truth_by_ccs_name.get(name)
     ccs_seq = ccs_by_name.get(name)
@@ -84,6 +85,8 @@ def main(argv=None):
       continue
     d_pred = analysis.edit_distance(seq, truth)
     d_ccs = analysis.edit_distance(ccs_seq, truth)
+    dist_cache[('polished', name)] = d_pred
+    dist_cache[('ccs', name)] = d_ccs
     tl = len(truth)
     rows.append({
         'read': name,
@@ -140,12 +143,16 @@ def main(argv=None):
 
     from deepconsensus_tpu import constants
 
-    def assessment(name, seq, avg_q, truth):
+    def assessment(kind, name, seq, avg_q, truth):
       # Strip the codebase gap token the same way edit_distance does,
-      # so numerator and denominator see identical sequences.
+      # so numerator and denominator see identical sequences. The
+      # O(len^2) distance dominates this script's cost, so reuse the
+      # main loop's result where available.
       seq_nogap = seq.replace(constants.GAP, '')
       truth_nogap = truth.replace(constants.GAP, '')
-      d = analysis.edit_distance(seq_nogap, truth_nogap)
+      d = dist_cache.get((kind, name))
+      if d is None:
+        d = analysis.edit_distance(seq_nogap, truth_nogap)
       aligned = max(len(seq_nogap), len(truth_nogap))
       return ym.ReadAssessment(
           name=name, length=len(seq_nogap), avg_quality=avg_q,
@@ -155,7 +162,7 @@ def main(argv=None):
     for label, reads in (
         ('polished', [
             assessment(
-                name, seq,
+                'polished', name, seq,
                 phred.avg_phred(phred.quality_string_to_array(qual)),
                 truth_by_ccs_name[name])
             for name, (seq, qual) in sorted(polished.items())
@@ -163,7 +170,7 @@ def main(argv=None):
         ]),
         ('ccs', [
             assessment(
-                name, ccs_by_name[name],
+                'ccs', name, ccs_by_name[name],
                 # quals is None for the BAM 0xFF no-quality sentinel
                 # (same guard as yield_metrics.assess_read).
                 phred.avg_phred(
