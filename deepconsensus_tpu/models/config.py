@@ -247,6 +247,12 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
 
   # TPU-native execution knobs (not in the reference).
   params.dtype = 'bfloat16'          # compute dtype; params stay float32
+  # MFU A/B levers (see scripts/profile_forward.py): one-hot matmul
+  # embeddings for small-vocab feature families (gather -> MXU), and
+  # the attention softmax accumulation dtype on the XLA path
+  # (None/'float32' = reference-matching default).
+  params.embed_onehot = False
+  params.attn_softmax_dtype = ml_collections.config_dict.placeholder(str)
   params.use_pallas_attention = False
   # Route AlignmentLoss through the whole-DP Pallas wavefront kernels
   # (forward scorer + custom-VJP backward) instead of the lax.scan DP.

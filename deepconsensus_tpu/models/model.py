@@ -44,13 +44,27 @@ def sinusoidal_position_encoding(
   return np.concatenate([np.sin(scaled), np.cos(scaled)], axis=1)
 
 
+# Vocab bound for the one-hot matmul embedding path: above this the
+# materialized one-hot outweighs any MXU win (pw/ip vocab 256 stay on
+# the gather path even with the flag on).
+_ONEHOT_MAX_VOCAB = 32
+
+
 class MaskedEmbed(nn.Module):
   """Embedding with zero vectors for id 0 and sqrt(dim) output scaling
-  (reference ModifiedOnDeviceEmbedding: networks.py:42-63)."""
+  (reference ModifiedOnDeviceEmbedding: networks.py:42-63).
+
+  onehot=True routes small-vocab lookups through a one-hot matmul
+  instead of a gather — a candidate MFU lever: gathers run on the
+  scalar/vector units while the matmul rides the MXU and fuses with
+  the downstream condenser. Values are identical (each output row is
+  a single table row either way); the flag exists to A/B on hardware.
+  """
 
   vocab_size: int
   features: int
   dtype: Any = jnp.float32
+  onehot: bool = False
 
   @nn.compact
   def __call__(self, ids: jnp.ndarray) -> jnp.ndarray:
@@ -60,9 +74,20 @@ class MaskedEmbed(nn.Module):
         (self.vocab_size, self.features),
         jnp.float32,
     )
-    # clip mode: out-of-range ids (already clipped upstream by
-    # format_rows) clamp instead of producing NaN fill values.
-    emb = jnp.take(table.astype(self.dtype), ids, axis=0, mode='clip')
+    if self.onehot and self.vocab_size <= _ONEHOT_MAX_VOCAB:
+      # Clip first to match the gather path's mode='clip' semantics
+      # (one_hot would zero out-of-range rows instead of clamping).
+      ids_c = jnp.clip(ids, 0, self.vocab_size - 1)
+      oh = jax.nn.one_hot(ids_c, self.vocab_size, dtype=self.dtype)
+      # HIGHEST precision: each output row is one table row, and the
+      # default-precision matmul would bf16-round f32 tables, breaking
+      # exact equivalence with the gather path.
+      emb = jnp.matmul(oh, table.astype(self.dtype),
+                       precision=jax.lax.Precision.HIGHEST)
+    else:
+      # clip mode: out-of-range ids (already clipped upstream by
+      # format_rows) clamp instead of producing NaN fill values.
+      emb = jnp.take(table.astype(self.dtype), ids, axis=0, mode='clip')
     emb = emb * jnp.asarray(self.features**0.5, self.dtype)
     mask = (ids != 0).astype(self.dtype)
     return emb * mask[..., None]
@@ -78,6 +103,12 @@ class BandedSelfAttention(nn.Module):
   attn_win_size: Optional[int]
   dtype: Any = jnp.float32
   use_pallas: bool = False
+  # Softmax accumulation dtype (XLA path). float32 matches the
+  # reference; bfloat16 is a candidate MFU lever (drops the f32
+  # up/downcast round-trip around the [B, N, L, L] weights) to A/B on
+  # hardware — banded logits are bounded, so bf16 is numerically safe
+  # at inference; keep f32 for training unless measured otherwise.
+  softmax_dtype: Any = jnp.float32
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
@@ -144,9 +175,9 @@ class BandedSelfAttention(nn.Module):
         i = np.arange(length)
         band = np.abs(i[:, None] - i[None, :]) <= self.attn_win_size
         logits = jnp.where(band[None, None, :, :], logits, -1e9)
-      weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
-          self.dtype
-      )
+      weights = jax.nn.softmax(
+          logits.astype(self.softmax_dtype), axis=-1
+      ).astype(self.dtype)
       # Expose attention maps like the reference's intermediate outputs
       # (attention_scores_{n}: encoder_stack.py:184-187); retrieve with
       # apply(..., capture_intermediates=True).
@@ -232,6 +263,8 @@ class EncoderStack(nn.Module):
           attn_win_size=p.attn_win_size,
           dtype=self.dtype,
           use_pallas=p.get('use_pallas_attention', False),
+          softmax_dtype=jnp.dtype(
+              p.get('attn_softmax_dtype', None) or 'float32'),
           name=f'self_attention_{n}',
       )
       x = run_block(
@@ -285,25 +318,31 @@ class DeepConsensusModel(nn.Module):
           constants.SEQ_VOCAB_SIZE, use_bias=True, dtype=jnp.float32,
           kernel_init=nn.initializers.glorot_uniform(), name='logits')
       return
+    onehot = p.get('embed_onehot', False)
     if p.use_bases or p.use_ccs:
       self.bases_embedding = MaskedEmbed(
           constants.SEQ_VOCAB_SIZE, p.per_base_hidden_size, dt,
-          name='bases_embedding')
+          onehot=onehot, name='bases_embedding')
     if p.use_pw:
       self.pw_embedding = MaskedEmbed(
-          p.PW_MAX + 1, p.pw_hidden_size, dt, name='pw_embedding')
+          p.PW_MAX + 1, p.pw_hidden_size, dt, onehot=onehot,
+          name='pw_embedding')
     if p.use_ip:
       self.ip_embedding = MaskedEmbed(
-          p.IP_MAX + 1, p.ip_hidden_size, dt, name='ip_embedding')
+          p.IP_MAX + 1, p.ip_hidden_size, dt, onehot=onehot,
+          name='ip_embedding')
     if p.use_strand:
       self.strand_embedding = MaskedEmbed(
-          p.STRAND_MAX + 1, p.strand_hidden_size, dt, name='strand_embedding')
+          p.STRAND_MAX + 1, p.strand_hidden_size, dt, onehot=onehot,
+          name='strand_embedding')
     if p.use_ccs_bq:
       self.ccs_bq_embedding = MaskedEmbed(
-          p.CCS_BQ_MAX, p.ccs_bq_hidden_size, dt, name='ccs_bq_embedding')
+          p.CCS_BQ_MAX, p.ccs_bq_hidden_size, dt, onehot=onehot,
+          name='ccs_bq_embedding')
     if p.use_sn:
       self.sn_embedding = MaskedEmbed(
-          p.SN_MAX + 1, p.sn_hidden_size, dt, name='sn_embedding')
+          p.SN_MAX + 1, p.sn_hidden_size, dt, onehot=onehot,
+          name='sn_embedding')
     if p.condense_transformer_input:
       self.condenser = nn.Dense(
           p.transformer_input_size, use_bias=False, dtype=dt,

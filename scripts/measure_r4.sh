@@ -47,6 +47,13 @@ run_stage() {  # name timeout_s cmd...
 # headline numbers.
 run_stage forward_profile 900 \
   python "$REPO/scripts/profile_forward.py" --batches 1024 2048 --steps 10
+# MFU lever A/Bs (values must match the default: tests lock equivalence).
+run_stage forward_onehot 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --set embed_onehot=true
+run_stage forward_bf16_softmax 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --set attn_softmax_dtype=bfloat16
 run_stage e2e_depth8 1200 \
   python "$REPO/scripts/bench_e2e.py" --repeats 6 --depth 8
 run_stage e2e_depth1 600 \

@@ -50,6 +50,10 @@ def main():
   ap.add_argument('--trace', default=None,
                   help='directory for a jax.profiler trace of the full '
                   'forward (inspect offline with tensorboard/xprof)')
+  ap.add_argument('--set', action='append', default=[], dest='overrides',
+                  metavar='KEY=VALUE',
+                  help='config override (e.g. embed_onehot=true, '
+                  'attn_softmax_dtype=bfloat16) for lever A/Bs')
   args = ap.parse_args()
 
   import jax
@@ -63,6 +67,10 @@ def main():
   from scripts._bench_common import make_rows
 
   params = config_lib.get_config('transformer_learn_values+test')
+  if args.overrides:
+    from deepconsensus_tpu.cli import _apply_overrides
+
+    _apply_overrides(params, args.overrides)
   config_lib.finalize_params(params, is_training=False)
   model = model_lib.get_model(params)
 
@@ -102,7 +110,10 @@ def main():
     x_enc = encoder_in.astype(dt)
     attn = model_lib.BandedSelfAttention(
         hidden_size=params.hidden_size, num_heads=params.num_heads,
-        dropout_rate=0.0, attn_win_size=params.attn_win_size, dtype=dt)
+        dropout_rate=0.0, attn_win_size=params.attn_win_size, dtype=dt,
+        use_pallas=params.get('use_pallas_attention', False),
+        softmax_dtype=jnp.dtype(
+            params.get('attn_softmax_dtype', None) or 'float32'))
     attn_vars = attn.init(jax.random.PRNGKey(1), x_enc, True)
     attn_fn = jax.jit(
         lambda v, x: attn.apply(v, x, True))

@@ -55,6 +55,41 @@ def test_forward_shapes_and_softmax():
   )
 
 
+def test_embed_onehot_matches_gather():
+  """The one-hot-matmul embedding lever (embed_onehot) must be a pure
+  execution-strategy change: identical predictions with the SAME
+  variables as the default gather path (each output row is a single
+  table row either way)."""
+  params = make_params()
+  rows = fake_rows(params, batch=3, seed=7)
+  model = model_lib.get_model(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  base = model.apply(variables, rows)
+  params_oh = make_params(embed_onehot=True)
+  model_oh = model_lib.get_model(params_oh)
+  got = model_oh.apply(variables, rows)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                             rtol=1e-6, atol=1e-6)
+  # Large-vocab families (pw/ip 256, sn 501) must stay on the gather
+  # path regardless of the flag (one-hot materialization cost).
+  assert model_lib._ONEHOT_MAX_VOCAB < 256
+
+
+def test_attn_softmax_dtype_lever():
+  """bf16 softmax accumulation runs and stays close to the f32 path
+  (banded logits are bounded); argmax calls must agree everywhere on
+  this scale of input."""
+  params = make_params()
+  rows = fake_rows(params, batch=2, seed=3)
+  model = model_lib.get_model(params)
+  variables = model.init(jax.random.PRNGKey(0), rows)
+  base = np.asarray(model.apply(variables, rows))
+  params_bf = make_params(attn_softmax_dtype='bfloat16')
+  got = np.asarray(model_lib.get_model(params_bf).apply(variables, rows))
+  np.testing.assert_allclose(got, base, atol=0.02)
+  assert (got.argmax(-1) == base.argmax(-1)).mean() > 0.999
+
+
 def test_intermediates_exposed():
   params = make_params()
   model = model_lib.get_model(params)
