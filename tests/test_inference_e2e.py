@@ -188,12 +188,11 @@ def test_preprocess_driver_multiprocess_equivalence(testdata_dir, tmp_path):
     assert a == b  # imap preserves order -> byte-identical shards
 
 
-def test_mesh_inference_matches_single_device(testdata_dir, tmp_path):
-  """DP-mesh inference produces byte-identical FASTQ to single-device
-  (VERDICT r1 #4: window batch sharded over the mesh data axis)."""
+def _run_single_vs_mesh(testdata_dir, tmp_path, make_run_kwargs):
+  """Runs the full pipeline single-device and on the 8-device DP mesh
+  and asserts byte-identical FASTQ. make_run_kwargs(options, mesh) ->
+  dict supplying the model source (runner= or checkpoint=[+mesh=])."""
   from deepconsensus_tpu.parallel import mesh as mesh_lib
-
-  params, variables = tiny_model()
 
   outputs = {}
   for name, mesh in (
@@ -203,21 +202,51 @@ def test_mesh_inference_matches_single_device(testdata_dir, tmp_path):
     options = runner_lib.InferenceOptions(
         batch_size=32, batch_zmws=4, limit=3, min_quality=0
     )
-    runner = runner_lib.ModelRunner(params, variables, options, mesh=mesh)
     out = str(tmp_path / f'{name}.fastq')
     counters = runner_lib.run_inference(
         subreads_to_ccs=str(testdata_dir / 'human_1m/subreads_to_ccs.bam'),
         ccs_bam=str(testdata_dir / 'human_1m/ccs.bam'),
-        checkpoint=None,
         output=out,
         options=options,
-        runner=runner,
+        **make_run_kwargs(options, mesh),
     )
     assert counters['n_zmw_pass'] == 3
     with open(out, 'rb') as f:
       outputs[name] = f.read()
   assert outputs['single'], 'empty FASTQ output'
   assert outputs['single'] == outputs['mesh']
+
+
+def test_mesh_inference_matches_single_device(testdata_dir, tmp_path):
+  """DP-mesh inference produces byte-identical FASTQ to single-device
+  (VERDICT r1 #4: window batch sharded over the mesh data axis)."""
+  params, variables = tiny_model()
+  _run_single_vs_mesh(
+      testdata_dir, tmp_path,
+      lambda options, mesh: {
+          'checkpoint': None,
+          'runner': runner_lib.ModelRunner(
+              params, variables, options, mesh=mesh),
+      })
+
+
+def test_exported_artifact_mesh_inference_e2e(testdata_dir, tmp_path):
+  """The full run_inference pipeline (BAM -> featurize -> model ->
+  stitch -> FASTQ) serving an exported StableHLO artifact over a DP
+  mesh — the from_checkpoint auto-detect + shard_map serving path the
+  CLI's `--checkpoint <export_dir> --dp N` takes — byte-matches the
+  single-device artifact run."""
+  from deepconsensus_tpu.models import export as export_lib
+
+  params, variables = tiny_model()
+  export_dir = str(tmp_path / 'export')
+  # checkpoint_path is unused when variables= and params= are given.
+  export_lib.export_model(
+      checkpoint_path=export_dir, out_dir=export_dir, batch_size=32,
+      variables=variables, params=params)
+  _run_single_vs_mesh(
+      testdata_dir, tmp_path,
+      lambda options, mesh: {'checkpoint': export_dir, 'mesh': mesh})
 
 
 def test_mesh_batch_divisibility_guard():

@@ -106,6 +106,30 @@ def test_native_read_tfrecord_records(tmp_path):
   assert native.read_tfrecord_records(bad, compressed=False) is None
 
 
+def test_corrupt_shard_fails_loudly_not_silently(tmp_path):
+  """A corrupted shard must raise (either decode path), never yield a
+  truncated record stream that silently shrinks the dataset."""
+  import gzip as gzip_lib
+  import zlib
+
+  path = str(tmp_path / 'records.tfrecord.gz')
+  records = [b'a' * 5000, b'b' * 5000, b'c' * 5000]
+  with TFRecordWriter(path, compression='BGZF') as w:
+    for r in records:
+      w.write(r)
+  data = bytearray(open(path, 'rb').read())
+  data[len(data) // 2] ^= 0xFF  # flip a byte mid-stream
+  with open(path, 'wb') as f:
+    f.write(data)
+  for kwargs in ({}, {'native_decode': True}):
+    got = []
+    with pytest.raises((IOError, OSError, EOFError, zlib.error,
+                        gzip_lib.BadGzipFile)):
+      for rec in TFRecordReader(path, **kwargs):
+        got.append(rec)
+    assert len(got) < len(records)  # never a complete-looking stream
+
+
 def test_bgzf_shard_parses_via_tensorflow(tmp_path):
   """TF's GZIP TFRecordDataset reads BGZF-framed shards (wire compat:
   the default preprocess output stays consumable by the reference)."""
