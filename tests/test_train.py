@@ -56,6 +56,46 @@ def test_weight_decay_mask(tiny_params):
       assert not v, path
 
 
+def test_best_checkpoint_metric_selection(tiny_params, tmp_path, caplog):
+  """best_checkpoint.txt follows params.best_checkpoint_metric: the
+  default (per_example_accuracy) ties at 0.0 on held-out sets and
+  keeps the first checkpoint, while identity_pred tracks the real
+  peak; a typo'd metric warns loudly instead of silently never
+  updating (round-4 held-out artifact fallout)."""
+  import logging
+
+  def run(metric_name, evals):
+    params = config_lib.get_config('transformer_learn_values+test')
+    config_lib.finalize_params(params)
+    out = str(tmp_path / f'best_{metric_name.replace("/", "_")}')
+    with params.unlocked():
+      params.dtype = 'float32'
+      params.num_hidden_layers = 1
+      params.filter_size = 32
+      params.best_checkpoint_metric = metric_name
+    trainer = train_lib.Trainer(params=params, out_dir=out, mesh=None)
+    state = trainer.init_state(steps_total=10)
+    for step, metrics in evals:
+      trainer.save_checkpoint(state, step, metrics)
+    best = os.path.join(out, 'best_checkpoint.txt')
+    return open(best).read().strip() if os.path.exists(best) else None
+
+  trajectory = [
+      (1, {'eval/per_example_accuracy': 0.0, 'eval/identity_pred': 0.5}),
+      (2, {'eval/per_example_accuracy': 0.0, 'eval/identity_pred': 0.9}),
+      (3, {'eval/per_example_accuracy': 0.0, 'eval/identity_pred': 0.7}),
+  ]
+  # Reference default: all-zero per_example_accuracy -> first ckpt.
+  assert run('eval/per_example_accuracy', trajectory) == 'checkpoint-1'
+  # Identity selector finds the held-out peak.
+  assert run('eval/identity_pred', trajectory) == 'checkpoint-2'
+  # Typo'd name: loud warning, no best file.
+  with caplog.at_level(logging.WARNING):
+    got = run('eval/identity_typo', trajectory[:1])
+  assert got is None
+  assert any('best_checkpoint_metric' in r.message for r in caplog.records)
+
+
 def test_short_training_run(tiny_params, tmp_path, testdata_dir):
   out_dir = str(tmp_path / 'train_out')
   metrics = train_lib.run_training(
