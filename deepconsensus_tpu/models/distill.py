@@ -138,10 +138,8 @@ def run_distillation(
     }
 
   train_step = jax.jit(step, donate_argnums=(0,))
-  eval_step = trainer.eval_step_fn()
 
   step_count = 0
-  final: Dict[str, float] = {}
   for _ in range(num_epochs):
     for batch in train_ds.epoch():
       state, m = train_step(state, batch)
@@ -150,20 +148,9 @@ def run_distillation(
         trainer.log_metrics(
             step_count, 'train', {k: float(v) for k, v in m.items()}
         )
-  # Final eval + checkpoint.
-  sums: Dict[str, float] = {}
-  batches = 0
-  for batch in eval_ds.epoch():
-    out = {k: float(v) for k, v in eval_step(state, batch).items()}
-    for k, v in out.items():
-      sums[k] = sums.get(k, 0.0) + v
-    batches += 1
-  if batches:
-    final = {
-        'eval/loss': sums['loss'] / batches,
-        'eval/per_example_accuracy': (
-            sums['accuracy_correct'] / max(sums['accuracy_total'], 1)
-        ),
-    }
+  # Final eval + checkpoint, through the same aggregation as
+  # run_training so the metric key set (identity_pred, class
+  # accuracies, yield) and best_checkpoint_metric behave identically.
+  final = trainer.run_eval(state, eval_ds)
   trainer.save_checkpoint(state, step_count, final)
   return final

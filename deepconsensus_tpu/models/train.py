@@ -328,6 +328,44 @@ class Trainer:
         step, in_shardings=(None, {'rows': batch_sh, 'label': batch_sh})
     )
 
+  def run_eval(self, state, eval_ds) -> Dict[str, float]:
+    """One full eval epoch aggregated to the eval/* metric dict.
+
+    The single aggregation used by BOTH run_training and distill, so
+    their TSVs carry the same metric key set and
+    params.best_checkpoint_metric means the same thing everywhere."""
+    if getattr(self, '_cached_eval_step', None) is None:
+      self._cached_eval_step = self.eval_step_fn()
+    eval_step = self._cached_eval_step
+    sums: Dict[str, float] = {}
+    batches = 0
+    yield_metric = metrics_lib.YieldOverCCS()
+    for batch in eval_ds.epoch():
+      batch = self.globalize_batch(batch)
+      out = {k: float(v) for k, v in eval_step(state, batch).items()}
+      yield_metric.update(out['identity_ccs'], out['identity_pred'])
+      for k, v in out.items():
+        sums[k] = sums.get(k, 0.0) + v
+      batches += 1
+    if not batches:
+      return {}
+    acc = sums['accuracy_correct'] / max(sums['accuracy_total'], 1)
+    result = {
+        'eval/loss': sums['loss'] / batches,
+        constants.MAIN_EVAL_METRIC_NAME: acc,
+        'eval/identity_ccs': sums['identity_ccs'] / batches,
+        'eval/identity_pred': sums['identity_pred'] / batches,
+        'eval/yield_over_ccs': yield_metric.result(),
+    }
+    # Emit every class key unconditionally so the metric key set (and
+    # the TSV header) stays stable across evals.
+    for cls in range(constants.SEQ_VOCAB_SIZE):
+      total = sums.get(f'class{cls}_total', 0.0)
+      result[f'eval/class{cls}_accuracy'] = (
+          sums[f'class{cls}_correct'] / total if total else 0.0
+      )
+    return result
+
   # ---- checkpoints ---------------------------------------------------
   def save_checkpoint(self, state: TrainState, step: int,
                       eval_metrics: Dict[str, float]) -> str:
@@ -538,38 +576,10 @@ def run_training(
     # warm-started run would restart from step 0.
     state = trainer.restore_checkpoint(state, warm_start, params_only=True)
   train_step = trainer.train_step_fn()
-  eval_step = trainer.eval_step_fn()
   eval_every = eval_every or params.get('eval_every_n_steps', 3000)
 
   def run_eval(state) -> Dict[str, float]:
-    sums: Dict[str, float] = {}
-    batches = 0
-    yield_metric = metrics_lib.YieldOverCCS()
-    for batch in eval_ds.epoch():
-      batch = trainer.globalize_batch(batch)
-      out = {k: float(v) for k, v in eval_step(state, batch).items()}
-      yield_metric.update(out['identity_ccs'], out['identity_pred'])
-      for k, v in out.items():
-        sums[k] = sums.get(k, 0.0) + v
-      batches += 1
-    if not batches:
-      return {}
-    acc = sums['accuracy_correct'] / max(sums['accuracy_total'], 1)
-    result = {
-        'eval/loss': sums['loss'] / batches,
-        constants.MAIN_EVAL_METRIC_NAME: acc,
-        'eval/identity_ccs': sums['identity_ccs'] / batches,
-        'eval/identity_pred': sums['identity_pred'] / batches,
-        'eval/yield_over_ccs': yield_metric.result(),
-    }
-    # Emit every class key unconditionally so the metric key set (and
-    # the TSV header) stays stable across evals.
-    for cls in range(constants.SEQ_VOCAB_SIZE):
-      total = sums.get(f'class{cls}_total', 0.0)
-      result[f'eval/class{cls}_accuracy'] = (
-          sums[f'class{cls}_correct'] / total if total else 0.0
-      )
-    return result
+    return trainer.run_eval(state, eval_ds)
 
   # Crash-resume: pick up from the newest checkpoint in out_dir
   # (reference resumable training: model_utils.py:511-540).
