@@ -95,9 +95,46 @@ class TFRecordWriter:
 
 
 # Whole-shard native decode reads the full decompressed shard into
-# memory; skip it for shards whose compressed size suggests that's
-# unreasonable on the host (streaming fallback handles any size).
+# memory (transiently about twice: the C output buffer plus the Python
+# record list); skip it for shards that would be unreasonable on the
+# host (streaming fallback handles any size). The compressed cap is a
+# cheap pre-check; the decompressed cap is the real bound, probed from
+# BGZF per-block ISIZE fields without inflating anything. It is sized
+# so a few StreamingDataset workers decoding concurrently stay bounded.
 _NATIVE_MAX_COMPRESSED_BYTES = 512 * 1024 * 1024
+_NATIVE_MAX_DECOMPRESSED_BYTES = 1024 * 1024 * 1024
+
+
+def bgzf_decompressed_size(path: str) -> Optional[int]:
+  """Total decompressed size of a BGZF file by summing block ISIZEs.
+
+  Seeks block-to-block using the BSIZE extra subfield, so cost is two
+  small reads per 64 KiB block — no inflation. Returns None unless
+  EVERY member is a standard BGZF block: a partial sum or a gzip
+  footer ISIZE (mod 2^32, final member only) would under-report and
+  defeat the size gate, so non-conforming files report unknown and the
+  native decoder's in-C output cap becomes the enforcement point."""
+  try:
+    with open(path, 'rb') as f:
+      total = 0
+      while True:
+        start = f.tell()
+        hdr = f.read(18)
+        if not hdr:
+          return total
+        # gzip magic, deflate, FEXTRA set, XLEN=6, 'BC' subfield len 2.
+        if (len(hdr) < 18 or hdr[:4] != b'\x1f\x8b\x08\x04'
+            or hdr[10:12] != b'\x06\x00' or hdr[12:16] != b'BC\x02\x00'):
+          return None
+        bsize = int.from_bytes(hdr[16:18], 'little') + 1
+        f.seek(start + bsize - 4)
+        isize = f.read(4)
+        if len(isize) < 4:
+          return None  # truncated final block
+        total += int.from_bytes(isize, 'little')
+        # Position is already start + bsize (footer read ends there).
+  except OSError:
+    return None
 
 
 class TFRecordReader:
@@ -120,6 +157,9 @@ class TFRecordReader:
                native_threads: int = 4):
     if compression is None and path.endswith('.gz'):
       compression = 'GZIP'
+    import os
+
+    os.stat(path)  # fail fast on missing/unreadable paths (open is lazy)
     self._path = path
     self._compressed = compression in ('GZIP', 'BGZF')
     self._native = native_decode and not check_crc
@@ -134,11 +174,19 @@ class TFRecordReader:
 
       if os.path.getsize(self._path) > _NATIVE_MAX_COMPRESSED_BYTES:
         return None
+      if self._compressed:
+        # Cheap pre-gate: exact for conforming BGZF (the preprocess
+        # default). Non-BGZF reports None and the in-C max_out cap
+        # below is the enforcement point.
+        dsize = bgzf_decompressed_size(self._path)
+        if dsize is not None and dsize > _NATIVE_MAX_DECOMPRESSED_BYTES:
+          return None
       from deepconsensus_tpu import native
 
       return native.read_tfrecord_records(
           self._path, n_threads=self._native_threads,
-          compressed=self._compressed)
+          compressed=self._compressed,
+          max_out=_NATIVE_MAX_DECOMPRESSED_BYTES)
     except Exception:  # pragma: no cover - any native issue -> fallback
       return None
 
@@ -151,6 +199,10 @@ class TFRecordReader:
         self._consumed = True
         yield from records
         return
+    # Mark consumed as soon as streaming iteration begins — same moment
+    # the native path does — so a partially-consumed reader yields
+    # nothing on re-iteration regardless of which decode path ran.
+    self._consumed = True
     if self._f is None:
       self._f = (gzip.open(self._path, 'rb') if self._compressed
                  else open(self._path, 'rb'))
@@ -158,7 +210,6 @@ class TFRecordReader:
     while True:
       header = read(8)
       if not header:
-        self._consumed = True
         return
       if len(header) != 8:
         raise IOError('truncated TFRecord length header')

@@ -416,7 +416,13 @@ class StreamingDataset:
     finally:
       # Stop the producer when the consumer abandons the iterator
       # (GeneratorExit) so retries don't accumulate blocked threads.
+      # Then JOIN it: its own finally terminates+joins the worker
+      # processes, so returning before it finishes would leave workers
+      # decoding (and competing for cores) into whatever runs next.
+      # Bounded so a wedged worker can't hang the consumer; daemons
+      # die with the interpreter in that case.
       stop.set()
+      thread.join(timeout=15)
 
 
 def prefetch_iterator(iterator, depth: int = 2):

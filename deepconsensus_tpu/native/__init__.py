@@ -85,6 +85,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_int,
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_size_t,
     ]
     lib.dc_gzip_decompress.restype = ctypes.c_int
     lib.dc_gzip_decompress.argtypes = [
@@ -92,6 +93,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_size_t,
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_size_t,
     ]
     lib.dc_tfrecord_index.restype = ctypes.c_int
     lib.dc_tfrecord_index.argtypes = [
@@ -135,13 +137,19 @@ def _looks_bgzf(raw: bytes) -> bool:
 
 
 def read_tfrecord_records(path: str, n_threads: int = 4,
-                          compressed: Optional[bool] = None):
+                          compressed: Optional[bool] = None,
+                          max_out: int = 0):
   """Decodes a whole TFRecord shard natively: gzip/BGZF inflate (BGZF
   blocks in parallel) + record framing in C, one Python slice per
   record. Returns a list of record payload bytes, or None -> caller
   must use the streaming Python fallback. Whole-shard decode trades
   memory (the decompressed shard) for the per-record Python
-  read/struct overhead that dominates the measured decode path."""
+  read/struct overhead that dominates the measured decode path.
+
+  max_out (0 = unlimited) bounds the decompressed size in C: BGZF
+  rejects from the block scan before inflating anything; arbitrary
+  gzip aborts as soon as output exceeds the cap. Either way the caller
+  gets None and must stream."""
   lib = get_lib()
   if lib is None:
     return None
@@ -159,10 +167,14 @@ def read_tfrecord_records(path: str, n_threads: int = 4,
   rc = 1
   if _looks_bgzf(raw):
     rc = lib.dc_bgzf_decompress(raw, len(raw), n_threads,
-                                ctypes.byref(out), ctypes.byref(out_len))
+                                ctypes.byref(out), ctypes.byref(out_len),
+                                max_out)
+    if rc == 6:  # over max_out — retrying via gzip would just re-reject
+      return None
   if rc != 0:
     rc = lib.dc_gzip_decompress(raw, len(raw),
-                                ctypes.byref(out), ctypes.byref(out_len))
+                                ctypes.byref(out), ctypes.byref(out_len),
+                                max_out)
   if rc != 0:
     return None
   del raw  # compressed copy no longer needed; keep the peak low

@@ -85,11 +85,16 @@ extern "C" {
 
 // Decompresses a whole BGZF buffer with n_threads workers.
 // Returns 0 on success; *out is malloc'd (caller frees via dc_free).
+// max_out caps the decompressed size (0 = unlimited): the block scan
+// knows the exact total before any allocation, so an oversized buffer
+// is rejected (rc 6) before a byte is inflated — callers fall back to
+// the streaming Python path, which holds only small buffers.
 int dc_bgzf_decompress(const uint8_t* data, size_t len, int n_threads,
-                       uint8_t** out, size_t* out_len) {
+                       uint8_t** out, size_t* out_len, size_t max_out) {
   std::vector<Block> blocks;
   size_t total = 0;
   if (!scan_blocks(data, len, &blocks, &total)) return 1;
+  if (max_out && total > max_out) return 6;
   uint8_t* buffer = (uint8_t*)malloc(total ? total : 1);
   if (!buffer) return 2;
 
@@ -144,7 +149,7 @@ int dc_bgzf_decompress_file(const char* path, int n_threads, uint8_t** out,
     free(data);
     return 13;
   }
-  const int rc = dc_bgzf_decompress(data, size, n_threads, out, out_len);
+  const int rc = dc_bgzf_decompress(data, size, n_threads, out, out_len, 0);
   free(data);
   return rc;
 }
@@ -156,8 +161,11 @@ void dc_free(uint8_t* ptr) { free(ptr); }
 // pure-Python writer or the reference's TF writer has one member and
 // no BC field, so the parallel block path can't apply). Serial, but
 // the inflate + framing cost still moves from Python to C.
+// max_out (0 = unlimited) aborts with rc 6 as soon as the output
+// exceeds the cap — the only sound bound for arbitrary gzip, whose
+// footer ISIZE wraps mod 2^32 and covers only the final member.
 int dc_gzip_decompress(const uint8_t* data, size_t len, uint8_t** out,
-                       size_t* out_len) {
+                       size_t* out_len, size_t max_out) {
   // avail_in is a uInt; a >=4 GiB input would silently truncate to
   // len mod 2^32 (possibly decoding a clean prefix and returning 0).
   if (len > UINT_MAX) return 5;
@@ -190,6 +198,14 @@ int dc_gzip_decompress(const uint8_t* data, size_t len, uint8_t** out,
     zs.avail_out = (uInt)(cap - total);
     const int ret = inflate(&zs, Z_NO_FLUSH);
     total = cap - zs.avail_out;
+    // Cap check must follow EVERY inflate call: the Z_STREAM_END exit
+    // below must not return success for an over-cap output that fit
+    // the adaptive buffer in one call.
+    if (max_out && total > max_out) {
+      inflateEnd(&zs);
+      free(buffer);
+      return 6;
+    }
     if (ret == Z_STREAM_END) {
       if (zs.avail_in == 0) break;
       // Concatenated member: restart on the remaining input.

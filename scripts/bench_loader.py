@@ -22,6 +22,7 @@ import time
 def measure(pattern, params, batch_size, workers, n_batches, native):
   env_before = os.environ.get('DC_TPU_NO_NATIVE')
   os.environ['DC_TPU_NO_NATIVE'] = '' if native else '1'
+  it = None
   try:
     from deepconsensus_tpu.models.data import StreamingDataset
 
@@ -38,6 +39,10 @@ def measure(pattern, params, batch_size, workers, n_batches, native):
     dt = time.perf_counter() - t0
     return n * batch_size / dt
   finally:
+    if it is not None:
+      # Deterministic worker teardown: on this 1-core host a previous
+      # leg's lingering workers would skew the next leg's numbers.
+      it.close()
     if env_before is None:
       os.environ.pop('DC_TPU_NO_NATIVE', None)
     else:

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 opportunistic TPU probe loop (VERDICT r4 "What's weak" #1 /
+# "Next round" #1): ping the tunneled chip every DC_PROBE_INTERVAL
+# seconds for the whole round, log every attempt to PROBE_LOG_r5.jsonl
+# (proof of round-long coverage if the chip never answers), and fire
+# the staged measurement sweep scripts/measure_r4.sh exactly once on
+# the first successful probe.
+#
+# Run detached:  nohup bash scripts/probe_loop.sh &
+# State files:
+#   .tpu_alive          — present while the last probe succeeded
+#   .measure_r4_fired   — sweep has been launched (guard against refire)
+set -u
+REPO=/root/repo
+LOG=$REPO/PROBE_LOG_r5.jsonl
+MEASURE_LOG=$REPO/measure_r5_run.log
+INTERVAL=${DC_PROBE_INTERVAL:-150}
+mkdir -p "$REPO/MEASURED_TPU_r4.d"
+
+probe() {
+  timeout 90 env PYTHONPATH=$REPO:/root/.axon_site JAX_PLATFORMS='' \
+    python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" \
+    >/dev/null 2>&1
+}
+
+while true; do
+  ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if probe; then
+    echo "{\"ts\": \"$ts\", \"alive\": true}" >> "$LOG"
+    touch "$REPO/.tpu_alive"
+    if [ ! -e "$REPO/.measure_r4_fired" ]; then
+      touch "$REPO/.measure_r4_fired"
+      echo "{\"ts\": \"$ts\", \"event\": \"firing measure_r4.sh\"}" >> "$LOG"
+      bash "$REPO/scripts/measure_r4.sh" > "$MEASURE_LOG" 2>&1
+      rc=$?
+      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_r4.sh done\", \"rc\": $rc}" >> "$LOG"
+    fi
+  else
+    echo "{\"ts\": \"$ts\", \"alive\": false}" >> "$LOG"
+    rm -f "$REPO/.tpu_alive"
+  fi
+  sleep "$INTERVAL"
+done
