@@ -235,6 +235,17 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.loader_workers = 0
   params.loss_function = 'alignment_loss'
 
+  # Training-time window augmentation (no reference counterpart: the
+  # reference effectively never repeats a window across ~100M-example
+  # epochs, train_tpu_model.md:234-239; augmentation substitutes for
+  # that diversity on small corpora). Probabilities are per example,
+  # applied to training batches only (models/data.py:augment_batch).
+  params.augment = False
+  params.augment_perm_prob = 0.5     # shuffle subread order
+  params.augment_drop_prob = 0.3     # downsample subreads (keep >= half)
+  params.augment_rc_prob = 0.5       # reverse-complement the window
+  params.augment_jitter_prob = 0.3   # +/-1 jitter on nonzero PW/IP
+
   # AlignmentLoss parameters (reference: model_configs.py:320-323).
   params.del_cost = 10.0
   params.loss_reg = 0.1

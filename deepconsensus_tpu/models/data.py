@@ -486,3 +486,159 @@ def prefetch_iterator(iterator, depth: int = 2):
       except queue.Empty:
         break
     thread.join(timeout=10)
+
+
+# Complement map over SEQ_VOCAB ' ATCG': gap fixed, A<->T, C<->G.
+_COMPLEMENT_LUT = np.array([0, 2, 1, 4, 3], dtype=constants.NP_DATA_TYPE)
+# Strand values (constants.Strand): UNKNOWN fixed, FORWARD<->REVERSE.
+_STRAND_FLIP_LUT = np.array([0, 2, 1], dtype=constants.NP_DATA_TYPE)
+# SN rows are per-channel [A, C, G, T]; under reverse-complement each
+# base is read as its partner, so channels swap A<->T, C<->G.
+_SN_RC_ORDER = np.array([3, 2, 1, 0])
+
+
+def augment_batch(
+    batch: Dict[str, np.ndarray],
+    params: ml_collections.ConfigDict,
+    rng: np.random.Generator,
+) -> Dict[str, np.ndarray]:
+  """Training-time window augmentation over a formatted (rows, label)
+  batch. No reference counterpart: the reference trains on ~100M unique
+  windows (train_tpu_model.md:234-239) while small corpora re-show the
+  same ones, so augmentation substitutes for data diversity. Four
+  independent per-example transforms, each gated by its
+  params.augment_*_prob:
+
+    * subread permutation — shuffle the order of present subreads
+      (consensus is order-invariant; the model should be too);
+    * subread downsampling — keep a random >= half subset, compacted
+      to the front (simulates lower-pass ZMWs);
+    * reverse-complement — flip the occupied extent of every row along
+      the window, complement bases/ccs/label, swap strand and SN
+      channels (the same molecule read in the other orientation);
+    * PW/IP jitter — +/-1 on a quarter of nonzero kinetics entries,
+      clipped back to [1, PW_MAX/IP_MAX].
+
+  Returns a new batch; never mutates the input. Presence of a subread
+  is read off its strand row (absent rows are all-zero = UNKNOWN).
+  """
+  rows = batch['rows'].copy()  # [B, H, L, 1]
+  label = batch['label'].copy() if batch.get('label') is not None else None
+  b, _, length, _ = rows.shape
+  p = params.max_passes
+  blocks = rows[:, : 4 * p, :, 0].reshape(b, 4, p, length)  # views rows
+  bases, pw, ip, strand = (blocks[:, i] for i in range(4))
+  present = strand.max(axis=2) > 0  # [B, P]
+  n_present = present.sum(axis=1)  # [B]
+
+  # --- subread permutation + downsampling (one combined gather) ---
+  perm_on = rng.random(b) < params.get('augment_perm_prob', 0.0)
+  drop_on = rng.random(b) < params.get('augment_drop_prob', 0.0)
+  keep = np.where(
+      drop_on & (n_present > 1),
+      rng.integers(np.maximum(1, -(-n_present // 2)),
+                   np.maximum(n_present, 1) + 1),
+      n_present,
+  )
+  # Which subreads survive: a RANDOM size-`keep` subset of the present
+  # ones (selection must be random even when the independent
+  # permutation transform does not fire, or every drop would remove
+  # the trailing subreads and bias the augmented distribution).
+  sel_keys = np.where(present, rng.random((b, p)), 2.0)
+  sel_rank = np.argsort(np.argsort(sel_keys, axis=1), axis=1)
+  kept = (sel_rank < keep[:, None]) & present
+  # Output order: random when permuting, original subread order
+  # otherwise; non-kept rows sort to the end.
+  order_keys = np.where(
+      perm_on[:, None], rng.random((b, p)), np.arange(p)[None, :] / p
+  )
+  order_keys = np.where(kept, order_keys, 2.0)
+  order = np.argsort(order_keys, axis=1, kind='stable')  # [B, P]
+  if perm_on.any() or (keep < n_present).any():
+    sel = np.take_along_axis(
+        blocks, order[:, None, :, None], axis=2
+    )  # [B, 4, P, L]
+    # Zero out dropped tail (and previously-absent rows stay zero).
+    live = np.arange(p)[None, :] < keep[:, None]  # [B, P]
+    sel = np.where(live[:, None, :, None], sel, 0.0)
+    rows[:, : 4 * p, :, 0] = sel.reshape(b, 4 * p, length)
+    blocks = rows[:, : 4 * p, :, 0].reshape(b, 4, p, length)
+    bases, pw, ip, strand = (blocks[:, i] for i in range(4))
+
+  # --- reverse-complement ---
+  rc_on = rng.random(b) < params.get('augment_rc_prob', 0.0)
+  if rc_on.any():
+    ccs_row = 4 * p
+    sn_start = 4 * p + 1 + (1 if params.use_ccs_bq else 0)
+    # Occupied extent: last column with any base content (subreads or
+    # ccs); reversal happens inside it so tail padding stays the tail.
+    content = (bases.max(axis=1) > 0) | (rows[:, ccs_row, :, 0] > 0)
+    width = length - np.argmax(content[:, ::-1], axis=1)  # [B]
+    width = np.where(content.any(axis=1), width, 0)
+    rev_idx = np.arange(length)[None, :]  # [B, L] source index map
+    rev_idx = np.where(
+        rev_idx < width[:, None], width[:, None] - 1 - rev_idx, rev_idx
+    )
+    flip = rc_on[:, None]
+
+    def rev(block):  # [B, R, L] reverse occupied extent where rc_on
+      rev_b = np.take_along_axis(block, rev_idx[:, None, :], axis=2)
+      return np.where(flip[:, :, None] if block.ndim == 3 else flip,
+                      rev_b, block)
+
+    comp = _COMPLEMENT_LUT
+    new_bases = rev(comp[bases.astype(np.int64)])
+    rows[:, :p, :, 0] = np.where(flip[:, :, None], new_bases, bases)
+    rows[:, p : 2 * p, :, 0] = rev(pw)
+    rows[:, 2 * p : 3 * p, :, 0] = rev(ip)
+    flipped_strand = _STRAND_FLIP_LUT[strand.astype(np.int64)]
+    rows[:, 3 * p : 4 * p, :, 0] = np.where(
+        flip[:, :, None], flipped_strand, strand
+    )
+    ccs = rows[:, ccs_row : ccs_row + 1, :, 0]
+    # Fall-through must be the ORIGINAL row: rev()'s internal where
+    # would otherwise hand non-flipped examples the complemented (but
+    # unreversed) ccs.
+    ccs_rc = np.take_along_axis(
+        comp[ccs.astype(np.int64)], rev_idx[:, None, :], axis=2
+    )
+    rows[:, ccs_row : ccs_row + 1, :, 0] = np.where(
+        flip[:, :, None], ccs_rc, ccs
+    )
+    if params.use_ccs_bq:
+      rows[:, ccs_row + 1 : ccs_row + 2, :, 0] = rev(
+          rows[:, ccs_row + 1 : ccs_row + 2, :, 0]
+      )
+    sn = rows[:, sn_start : sn_start + 4, :, 0]
+    rows[:, sn_start : sn_start + 4, :, 0] = np.where(
+        flip[:, :, None], sn[:, _SN_RC_ORDER], sn
+    )
+    if label is not None and label.size:
+      # The loss treats the label as a gap-collapsible SEQUENCE
+      # (left_shift_sequence), so a full reverse + complement is exact;
+      # leading gaps are shifted away by the loss.
+      lab_rc = _COMPLEMENT_LUT[label.astype(np.int64)][:, ::-1]
+      label = np.where(rc_on[:, None], lab_rc, label).astype(label.dtype)
+
+  # --- PW/IP jitter ---
+  jit_on = rng.random(b) < params.get('augment_jitter_prob', 0.0)
+  if jit_on.any():
+    blocks = rows[:, : 4 * p, :, 0].reshape(b, 4, p, length)
+    for bi, cap in ((1, params.PW_MAX), (2, params.IP_MAX)):
+      block = blocks[:, bi]
+      delta = rng.integers(-1, 2, size=block.shape).astype(rows.dtype)
+      mask = (
+          jit_on[:, None, None]
+          & (block > 0)
+          & (rng.random(block.shape) < 0.25)
+      )
+      blocks[:, bi] = np.where(
+          mask, np.clip(block + delta, 1, cap), block
+      )
+    rows[:, : 4 * p, :, 0] = blocks.reshape(b, 4 * p, length)
+
+  out = dict(batch)
+  out['rows'] = rows
+  if label is not None:
+    out['label'] = label
+  return out

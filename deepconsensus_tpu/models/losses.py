@@ -105,6 +105,13 @@ class AlignmentLoss:
       return wavefront.alignment_scan(
           subs_costs, ins_costs, del_cost, seq_lens, minop, self.inf
       )
+    if self.use_pallas:
+      from deepconsensus_tpu.ops import wavefront_pallas
+
+      return wavefront_pallas.banded_alignment_scores_vjp(
+          subs_costs, ins_costs, seq_lens, self.del_cost,
+          self.loss_reg, int(self.width), self.inf,
+      )
     return wavefront.banded_alignment_scan(
         subs_costs, ins_costs, del_cost, seq_lens, int(self.width), minop,
         self.inf,

@@ -19,6 +19,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import ml_collections
+import numpy as np
 import optax
 from flax import struct
 from flax.training import train_state as ts_lib
@@ -626,13 +627,26 @@ def run_training(
             continue
           yield batch
 
+  def maybe_augmented():
+    # Training-time window augmentation (params.augment; applied to
+    # training batches only — eval batches go through run_eval
+    # untouched). Seeded off params.seed + resume step so a resumed
+    # run draws a fresh augmentation stream instead of replaying one.
+    if not params.get('augment', False):
+      return train_batches()
+    aug_rng = np.random.default_rng(params.seed + 7919 * (step + 1))
+    return (
+        data_lib.augment_batch(b, params, aug_rng)
+        for b in train_batches()
+    )
+
   final_metrics: Dict[str, float] = {}
   try:
     # Background prefetch: host-side decode/shuffle/stacking for batch
     # i+1 overlaps the device's step i (the async dispatch returns
     # before compute finishes). Reference counterpart: tf.data
     # prefetch(AUTOTUNE) in data_providers.py.
-    for batch in data_lib.prefetch_iterator(train_batches()):
+    for batch in data_lib.prefetch_iterator(maybe_augmented()):
       batch = trainer.globalize_batch(batch)
       with jax.profiler.StepTraceAnnotation('train', step_num=step):
         state, m = train_step(state, batch)

@@ -74,6 +74,9 @@ for u in 1 16; do
 done
 run_stage flash_band 900 \
   python "$REPO/scripts/bench_flash_band.py"
+# Banded alignment-DP scan-vs-Pallas A/B (round-5 kernel).
+run_stage banded_dp 900 \
+  python "$REPO/scripts/bench_banded_dp.py" --batch 256 --steps 5
 # Host-only (loader never touches the chip, but run it inside the sweep
 # so the core is otherwise idle).
 run_stage loader 900 \

@@ -1,0 +1,241 @@
+"""Training-time window augmentation (models/data.py:augment_batch)."""
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import data as data_lib
+
+
+@pytest.fixture(scope='module')
+def batch_and_params(testdata_dir):
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  ds = data_lib.DatasetIterator(
+      patterns=str(
+          testdata_dir / 'human_1m/tf_examples/train/train.tfrecord.gz'
+      ),
+      params=params,
+      batch_size=48,
+      seed=0,
+      shuffle=False,
+      limit=48,
+  )
+  return next(iter(ds)), params
+
+
+def with_probs(params, **probs):
+  p = config_lib.ml_collections.ConfigDict(params.to_dict())
+  for k in ('augment_perm_prob', 'augment_drop_prob', 'augment_rc_prob',
+            'augment_jitter_prob'):
+    p[k] = 0.0
+  for k, v in probs.items():
+    p[k] = v
+  return p
+
+
+def subread_blocks(rows, p):
+  return rows[:, : 4 * p, :, 0].reshape(rows.shape[0], 4, p,
+                                        rows.shape[2])
+
+
+def test_augment_noop_when_all_probs_zero(batch_and_params):
+  batch, params = batch_and_params
+  out = data_lib.augment_batch(batch, with_probs(params),
+                               np.random.default_rng(0))
+  np.testing.assert_array_equal(out['rows'], batch['rows'])
+  np.testing.assert_array_equal(out['label'], batch['label'])
+  assert out['rows'] is not batch['rows']  # never aliases the input
+
+
+def test_augment_preserves_shapes_and_input(batch_and_params):
+  batch, params = batch_and_params
+  rows_before = batch['rows'].copy()
+  label_before = batch['label'].copy()
+  p = with_probs(params, augment_perm_prob=1.0, augment_drop_prob=1.0,
+                 augment_rc_prob=1.0, augment_jitter_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(1))
+  assert out['rows'].shape == batch['rows'].shape
+  assert out['rows'].dtype == batch['rows'].dtype
+  assert out['label'].shape == batch['label'].shape
+  # The input batch is untouched.
+  np.testing.assert_array_equal(batch['rows'], rows_before)
+  np.testing.assert_array_equal(batch['label'], label_before)
+  # And the augmented batch actually differs.
+  assert not np.array_equal(out['rows'], batch['rows'])
+
+
+def test_permutation_preserves_subread_multiset(batch_and_params):
+  batch, params = batch_and_params
+  p = with_probs(params, augment_perm_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(2))
+  mp = params.max_passes
+  before = subread_blocks(batch['rows'], mp)
+  after = subread_blocks(out['rows'], mp)
+  changed = 0
+  for b in range(before.shape[0]):
+    # Each subread is the 4-feature tuple (bases, pw, ip, strand);
+    # permutation must preserve the multiset of tuples.
+    tb = {tuple(before[b, :, i].ravel()) for i in range(mp)}
+    ta = {tuple(after[b, :, i].ravel()) for i in range(mp)}
+    assert tb == ta
+    changed += int(
+        not np.array_equal(before[b], after[b])
+    )
+  assert changed > before.shape[0] // 2  # prob 1.0: most examples move
+  # ccs/sn rows and the label are untouched by permutation.
+  np.testing.assert_array_equal(
+      out['rows'][:, 4 * mp:], batch['rows'][:, 4 * mp:]
+  )
+  np.testing.assert_array_equal(out['label'], batch['label'])
+
+
+def test_downsample_keeps_at_least_half(batch_and_params):
+  batch, params = batch_and_params
+  p = with_probs(params, augment_drop_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(3))
+  mp = params.max_passes
+  before = subread_blocks(batch['rows'], mp)
+  after = subread_blocks(out['rows'], mp)
+  n_before = (before[:, 3].max(axis=2) > 0).sum(axis=1)
+  n_after = (after[:, 3].max(axis=2) > 0).sum(axis=1)
+  assert (n_after <= n_before).all()
+  assert (n_after >= -(-n_before // 2)).all()  # keep >= ceil(n/2)
+  assert (n_after >= 1).all()
+  # Kept subreads are a subset of the originals, compacted to front.
+  for b in range(before.shape[0]):
+    tb = {tuple(before[b, :, i].ravel()) for i in range(mp)}
+    for i in range(int(n_after[b])):
+      assert tuple(after[b, :, i].ravel()) in tb
+    # Tail is zero.
+    assert not after[b, :, int(n_after[b]):].any()
+
+
+def test_reverse_complement_is_involutive(batch_and_params):
+  batch, params = batch_and_params
+  p = with_probs(params, augment_rc_prob=1.0)
+  once = data_lib.augment_batch(batch, p, np.random.default_rng(4))
+  assert not np.array_equal(once['rows'], batch['rows'])
+  assert not np.array_equal(once['label'], batch['label'])
+  twice = data_lib.augment_batch(once, p, np.random.default_rng(5))
+  np.testing.assert_array_equal(twice['rows'], batch['rows'])
+  # Label: RC twice reverses the full row twice -> identity.
+  np.testing.assert_array_equal(twice['label'], batch['label'])
+
+
+def test_reverse_complement_flips_strand_and_sn(batch_and_params):
+  batch, params = batch_and_params
+  p = with_probs(params, augment_rc_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(6))
+  mp = params.max_passes
+  strand_b = batch['rows'][:, 3 * mp : 4 * mp, :, 0]
+  strand_a = out['rows'][:, 3 * mp : 4 * mp, :, 0]
+  # 1 <-> 2 swap: the multiset per example flips.
+  assert ((strand_b == 1).sum() == (strand_a == 2).sum())
+  assert ((strand_b == 2).sum() == (strand_a == 1).sum())
+  sn_start = 4 * mp + 1 + (1 if params.use_ccs_bq else 0)
+  sn_b = batch['rows'][:, sn_start : sn_start + 4, :, 0]
+  sn_a = out['rows'][:, sn_start : sn_start + 4, :, 0]
+  np.testing.assert_array_equal(sn_a, sn_b[:, [3, 2, 1, 0]])
+
+
+def test_jitter_bounded_and_sparse(batch_and_params):
+  batch, params = batch_and_params
+  p = with_probs(params, augment_jitter_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(7))
+  mp = params.max_passes
+  for lo, hi, cap in ((mp, 2 * mp, params.PW_MAX),
+                      (2 * mp, 3 * mp, params.IP_MAX)):
+    before = batch['rows'][:, lo:hi, :, 0]
+    after = out['rows'][:, lo:hi, :, 0]
+    # Zero (absent/gap) entries never become nonzero.
+    assert not after[before == 0].any()
+    nz = before > 0
+    assert (after[nz] >= 1).all() and (after[nz] <= cap).all()
+    assert np.abs(after[nz] - before[nz]).max() <= 1
+  # Bases/strand/ccs rows untouched.
+  np.testing.assert_array_equal(out['rows'][:, :mp], batch['rows'][:, :mp])
+  np.testing.assert_array_equal(
+      out['rows'][:, 3 * mp:], batch['rows'][:, 3 * mp:]
+  )
+
+
+def test_augmented_loss_stays_in_family(batch_and_params):
+  """The alignment loss of a fixed prediction against augmented labels
+  stays finite, and RC'd labels score identically to RC'd predictions
+  (sequence-level consistency of the label transform)."""
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import losses as losses_lib
+
+  batch, params = batch_and_params
+  p = with_probs(params, augment_rc_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(8))
+  y_true = jnp.asarray(batch['label'][:8])
+  y_true_rc = jnp.asarray(out['label'][:8])
+  rng = np.random.default_rng(0)
+  logits = jnp.asarray(
+      rng.normal(size=(8, params.max_length, 5)).astype(np.float32)
+  )
+  y_pred = jax.nn.softmax(logits)
+  loss = losses_lib.AlignmentLoss(del_cost=10.0, loss_reg=0.1)
+  base = float(loss(y_true, y_pred))
+  aug = float(loss(y_true_rc, y_pred))
+  assert np.isfinite(base) and np.isfinite(aug)
+  # RC both sides: reverse the prediction along the window and swap
+  # complement channels (vocab ' ATCG' -> [0, 2, 1, 4, 3]).
+  y_pred_rc = y_pred[:, ::-1, :][:, :, jnp.asarray([0, 2, 1, 4, 3])]
+  aug_both = float(loss(y_true_rc, y_pred_rc))
+  np.testing.assert_allclose(aug_both, base, rtol=1e-5)
+
+
+def test_rc_partial_batch_leaves_unflipped_examples_untouched(
+    batch_and_params):
+  """At rc_prob=0.5 the non-flipped examples' rows AND label must be
+  byte-identical to the input (review regression: the ccs row of
+  non-flipped examples was being complemented in place)."""
+  batch, params = batch_and_params
+  p = with_probs(params, augment_rc_prob=0.5)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(9))
+  mp = params.max_passes
+  # RC is the only enabled transform, so an example is flipped iff its
+  # bases block changed; every other example must be untouched in FULL
+  # (the regression: their ccs row came back complemented).
+  rc_on = np.array([
+      not np.array_equal(out['rows'][b, :mp], batch['rows'][b, :mp])
+      for b in range(batch['rows'].shape[0])
+  ])
+  assert rc_on.any() and not rc_on.all()  # both kinds in the batch
+  np.testing.assert_array_equal(
+      out['rows'][~rc_on], batch['rows'][~rc_on]
+  )
+  np.testing.assert_array_equal(
+      out['label'][~rc_on], batch['label'][~rc_on]
+  )
+
+
+def test_downsample_subset_is_random_without_permutation(
+    batch_and_params):
+  """Drop-only augmentation (perm off) must remove a RANDOM subset, not
+  always the trailing subreads (review regression), while preserving
+  the original relative order of the kept ones."""
+  batch, params = batch_and_params
+  p = with_probs(params, augment_drop_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(10))
+  mp = params.max_passes
+  before = subread_blocks(batch['rows'], mp)
+  after = subread_blocks(out['rows'], mp)
+  n_after = (after[:, 3].max(axis=2) > 0).sum(axis=1)
+  non_tail_drop = 0
+  for b in range(before.shape[0]):
+    k = int(n_after[b])
+    sig = lambda blk, i: tuple(blk[b, :, i].ravel())
+    kept = [sig(after, i) for i in range(k)]
+    orig = [sig(before, i) for i in range(mp)]
+    # Kept rows appear in their original relative order.
+    pos = [orig.index(s) for s in kept]
+    assert pos == sorted(pos), (b, pos)
+    # Not simply the first k originals?
+    if kept != orig[:k]:
+      non_tail_drop += 1
+  assert non_tail_drop > before.shape[0] // 4

@@ -70,8 +70,6 @@ ALIGNMENT_LOSS_CASES = [
 )
 def test_alignment_loss(sequences, del_cost, loss_reg, width, expected,
                         use_pallas):
-  if use_pallas and width is not None:
-    pytest.skip('Pallas path covers the unbanded (training) DP only')
   y_true, y_pred = convert_seqs(sequences)
   loss = losses.AlignmentLoss(
       del_cost=del_cost, loss_reg=loss_reg, width=width,
