@@ -33,6 +33,11 @@ jax.config.update('jax_platforms', 'cpu')
 from deepconsensus_tpu.cli import main
 sys.exit(main(sys.argv[1:]))
 EOF
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "sweep $name FAILED rc=$rc"
+    return $rc
+  fi
   echo "--- $name trajectory (eval/identity_pred) ---"
   cut -f1,8 "$out/checkpoint_metrics.tsv" 2>/dev/null | tail -25
   cat "$out/best_checkpoint.txt" 2>/dev/null

@@ -1,0 +1,220 @@
+"""Sustained-scale end-to-end soak (VERDICT r4 #7).
+
+Replicates the bundled 10-ZMW human_1m BAMs to thousands of distinct
+ZMWs (byte-level record patching: qname + zm tag get a per-copy offset,
+cigars/quals/kinetics preserved exactly — mirrors the reference's
+full-SMRT-cell production pattern, quick_start.md:82-99), then runs
+`dctpu run` over them as a subprocess while sampling throughput (FASTQ
+growth), RSS, and /dev/shm segment count. Emits one JSON line with the
+soak verdict: sustained ZMW/s, first-vs-last-quartile throughput ratio
+(flatness), peak RSS, peak shm segments.
+
+  python scripts/soak_e2e.py --copies 500 --out_dir /root/soak_r5
+"""
+import argparse
+import gzip
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+TESTDATA = '/root/reference/deepconsensus/testdata/human_1m'
+ZMW_STRIDE = 1_000_000  # copy c adds c * stride to every ZMW id
+
+
+def _patch_record(block: bytes, zmw_offset: int) -> bytes:
+  """Returns the record with qname's ZMW and the zm:i tag offset."""
+  (ref_id, pos, l_read_name, mapq, bin_, n_cigar, flag, l_seq, next_ref,
+   next_pos, tlen) = struct.unpack('<iiBBHHHiiii', block[:32])
+  name = block[32 : 32 + l_read_name - 1].decode('ascii')
+  rest = block[32 + l_read_name :]
+  movie, zmw, tail = name.split('/', 2)
+  new_name = f'{movie}/{int(zmw) + zmw_offset}/{tail}'.encode('ascii')
+  new_lrn = len(new_name) + 1
+
+  # Walk the tag region (after cigar+seq+qual) to rewrite zm:i.
+  cigar_seq_qual = n_cigar * 4 + (l_seq + 1) // 2 + l_seq
+  tags = bytearray(rest[cigar_seq_qual:])
+  p = 0
+  sizes = {ord('A'): 1, ord('c'): 1, ord('C'): 1, ord('s'): 2,
+           ord('S'): 2, ord('i'): 4, ord('I'): 4, ord('f'): 4}
+  while p + 3 <= len(tags):
+    tag = bytes(tags[p : p + 2])
+    vt = tags[p + 2]
+    q = p + 3
+    if vt in sizes:
+      if tag == b'zm' and vt in (ord('i'), ord('I')):
+        (zm_val,) = struct.unpack_from('<i', tags, q)
+        struct.pack_into('<i', tags, q, zm_val + zmw_offset)
+      q += sizes[vt]
+    elif vt in (ord('Z'), ord('H')):
+      while tags[q] != 0:
+        q += 1
+      q += 1
+    elif vt == ord('B'):
+      sub = tags[q]
+      (n,) = struct.unpack_from('<I', tags, q + 1)
+      q += 5 + n * sizes[sub]
+    else:
+      raise ValueError(f'unknown tag type {chr(vt)}')
+    p = q
+
+  head = struct.pack('<iiBBHHHiiii', ref_id, pos, new_lrn, mapq, bin_,
+                     n_cigar, flag, l_seq, next_ref, next_pos, tlen)
+  body = head + new_name + b'\x00' + rest[: cigar_seq_qual] + bytes(tags)
+  return struct.pack('<i', len(body)) + body
+
+
+def replicate_bam(src: str, dst: str, copies: int) -> int:
+  """Writes `copies` ZMW-offset replicas of src's records; returns the
+  record count written."""
+  from deepconsensus_tpu.io.bam_writer import BgzfWriter
+
+  raw = gzip.open(src, 'rb').read()
+  assert raw[:4] == b'BAM\x01', src
+  (l_text,) = struct.unpack_from('<i', raw, 4)
+  p = 8 + l_text
+  (n_ref,) = struct.unpack_from('<i', raw, p)
+  p += 4
+  for _ in range(n_ref):
+    (l_name,) = struct.unpack_from('<i', raw, p)
+    p += 4 + l_name + 4
+  header_end = p
+
+  records = []
+  while p < len(raw):
+    (size,) = struct.unpack_from('<i', raw, p)
+    records.append(raw[p + 4 : p + 4 + size])
+    p += 4 + size
+
+  n = 0
+  with BgzfWriter(dst) as out:
+    out.write(raw[:header_end])
+    for c in range(copies):
+      off = c * ZMW_STRIDE
+      for block in records:
+        out.write(_patch_record(block, off) if off else
+                  struct.pack('<i', len(block)) + block)
+        n += 1
+  return n
+
+
+def count_fastq_records(path: str) -> int:
+  if not os.path.exists(path):
+    return 0
+  n = 0
+  with open(path, 'rb') as f:
+    for _ in f:
+      n += 1
+  return n // 4
+
+
+def main():
+  ap = argparse.ArgumentParser()
+  ap.add_argument('--copies', type=int, default=500)
+  ap.add_argument('--out_dir', default='/root/soak_r5')
+  ap.add_argument('--checkpoint',
+                  default='/root/distill_r4_ep4/checkpoints/checkpoint-152')
+  ap.add_argument('--batch_zmws', type=int, default=100)
+  ap.add_argument('--sample_every', type=float, default=10.0)
+  ap.add_argument('--min_minutes', type=float, default=10.0)
+  args = ap.parse_args()
+
+  os.makedirs(args.out_dir, exist_ok=True)
+  sub_bam = os.path.join(args.out_dir, f'subreads_x{args.copies}.bam')
+  ccs_bam = os.path.join(args.out_dir, f'ccs_x{args.copies}.bam')
+  for src, dst in ((f'{TESTDATA}/subreads_to_ccs.bam', sub_bam),
+                   (f'{TESTDATA}/ccs.bam', ccs_bam)):
+    if not os.path.exists(dst):
+      t0 = time.time()
+      n = replicate_bam(src, dst, args.copies)
+      print(f'replicated {src} -> {dst}: {n} records '
+            f'({time.time() - t0:.1f}s)', flush=True)
+
+  out_fastq = os.path.join(args.out_dir, 'soak.fastq')
+  for stale in (out_fastq, out_fastq + '.runtime.csv',
+                out_fastq + '.inference.json'):
+    if os.path.exists(stale):
+      os.remove(stale)
+  child_code = (
+      'import jax, sys\n'
+      "jax.config.update('jax_platforms', 'cpu')\n"
+      'from deepconsensus_tpu.cli import main\n'
+      'sys.exit(main(sys.argv[1:]))\n'
+  )
+  cmd = [
+      sys.executable, '-c', child_code, 'run',
+      '--subreads_to_ccs', sub_bam, '--ccs_bam', ccs_bam,
+      '--checkpoint', args.checkpoint, '--output', out_fastq,
+      '--batch_zmws', str(args.batch_zmws),
+      '--skip_windows_above', '0', '--min_quality', '0',
+  ]
+  env = dict(os.environ)
+  env['PYTHONPATH'] = '/root/repo:' + env.get('PYTHONPATH', '')
+  proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                          stderr=subprocess.STDOUT)
+
+  samples = []
+  t0 = time.time()
+  while proc.poll() is None:
+    time.sleep(args.sample_every)
+    try:
+      with open(f'/proc/{proc.pid}/status') as f:
+        rss_kb = next(
+            (int(l.split()[1]) for l in f if l.startswith('VmRSS')), 0
+        )
+    except OSError:
+      rss_kb = 0
+    n_shm = len(os.listdir('/dev/shm')) if os.path.isdir('/dev/shm') else 0
+    sample = {
+        't': round(time.time() - t0, 1),
+        'zmws_done': count_fastq_records(out_fastq),
+        'rss_mb': round(rss_kb / 1024, 1),
+        'shm_segments': n_shm,
+    }
+    samples.append(sample)
+    print(json.dumps(sample), flush=True)
+  rc = proc.returncode
+  wall = time.time() - t0
+
+  with open(os.path.join(args.out_dir, 'soak_samples.jsonl'), 'w') as f:
+    for s in samples:
+      f.write(json.dumps(s) + '\n')
+
+  total = count_fastq_records(out_fastq)
+  # Interval throughputs -> first/last quartile flatness ratio.
+  rates = []
+  for a, b in zip(samples, samples[1:]):
+    dt = b['t'] - a['t']
+    if dt > 0:
+      rates.append((b['zmws_done'] - a['zmws_done']) / dt)
+  q = max(1, len(rates) // 4)
+  first_q = sum(rates[:q]) / q if rates else 0.0
+  last_q = sum(rates[-q:]) / q if rates else 0.0
+  verdict = {
+      'soak': 'e2e',
+      'rc': rc,
+      'zmws_total': total,
+      'wall_s': round(wall, 1),
+      'zmw_per_s': round(total / wall, 2) if wall else 0.0,
+      'first_quartile_zmw_per_s': round(first_q, 2),
+      'last_quartile_zmw_per_s': round(last_q, 2),
+      'throughput_flat': bool(
+          first_q > 0 and 0.7 <= last_q / first_q <= 1.4
+      ),
+      'rss_mb_max': max((s['rss_mb'] for s in samples), default=0),
+      'rss_mb_final': samples[-1]['rss_mb'] if samples else 0,
+      'shm_segments_max': max(
+          (s['shm_segments'] for s in samples), default=0
+      ),
+      'ran_minutes': round(wall / 60, 1),
+      'long_enough': wall >= args.min_minutes * 60,
+  }
+  print(json.dumps(verdict), flush=True)
+  return 0 if rc == 0 else rc
+
+
+if __name__ == '__main__':
+  raise SystemExit(main())

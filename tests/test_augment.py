@@ -239,3 +239,65 @@ def test_downsample_subset_is_random_without_permutation(
     if kept != orig[:k]:
       non_tail_drop += 1
   assert non_tail_drop > before.shape[0] // 4
+
+
+@pytest.fixture(scope='module')
+def bq_batch_and_params(batch_and_params):
+  """Synthesizes a use_ccs_bq=True batch by inserting a ccs_bq row
+  (the bundled shard predates bq; the transform logic is what is under
+  test — review finding: the bq branch had zero coverage)."""
+  batch, params = batch_and_params
+  rows = batch['rows']
+  mp = params.max_passes
+  ccs_row = 4 * mp
+  b, _, length, _ = rows.shape
+  rng = np.random.default_rng(42)
+  bq = rng.integers(0, 93, size=(b, 1, length, 1)).astype(rows.dtype)
+  # -1 padding beyond the ccs content extent (pileup's bq pad rule).
+  ccs_content = rows[:, ccs_row : ccs_row + 1, :, :] > 0
+  bq = np.where(ccs_content, bq, -1.0)
+  rows_bq = np.concatenate(
+      [rows[:, : ccs_row + 1], bq, rows[:, ccs_row + 1 :]], axis=1
+  )
+  p = config_lib.ml_collections.ConfigDict(params.to_dict())
+  p.use_ccs_bq = True
+  p.total_rows = params.total_rows + 1
+  return {'rows': rows_bq, 'label': batch['label'].copy()}, p
+
+
+def test_rc_with_ccs_bq_row(bq_batch_and_params):
+  """RC with use_ccs_bq: the bq row reverses with the window (staying
+  aligned to the RC'd ccs row), the SN swap applies to the SN rows at
+  their shifted offset, and RC remains involutive."""
+  batch, params = bq_batch_and_params
+  p = with_probs(params, augment_rc_prob=1.0)
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(11))
+  mp = params.max_passes
+  ccs_row = 4 * mp
+  sn_start = ccs_row + 2  # ccs, ccs_bq, then 4 SN rows
+  # SN swap hit the actual SN rows, not the bq row.
+  np.testing.assert_array_equal(
+      out['rows'][:, sn_start : sn_start + 4],
+      batch['rows'][:, sn_start : sn_start + 4][:, [3, 2, 1, 0]],
+  )
+  # bq stays aligned with ccs: wherever the RC'd ccs has a base, the
+  # RC'd bq carries the value that base had before the flip.
+  ccs_b = batch['rows'][:, ccs_row, :, 0]
+  bq_b = batch['rows'][:, ccs_row + 1, :, 0]
+  ccs_a = out['rows'][:, ccs_row, :, 0]
+  bq_a = out['rows'][:, ccs_row + 1, :, 0]
+  comp = np.array([0, 2, 1, 4, 3], dtype=ccs_b.dtype)
+  for b_i in range(ccs_b.shape[0]):
+    nz_b = np.flatnonzero(ccs_b[b_i] > 0)
+    nz_a = np.flatnonzero(ccs_a[b_i] > 0)
+    assert len(nz_b) == len(nz_a)
+    # Reversed base-by-base: k-th base of RC'd ccs == complement of
+    # the k-th-from-last original base, and its bq follows it.
+    np.testing.assert_array_equal(
+        ccs_a[b_i, nz_a], comp[ccs_b[b_i, nz_b[::-1]].astype(int)]
+    )
+    np.testing.assert_array_equal(bq_a[b_i, nz_a], bq_b[b_i, nz_b[::-1]])
+  # Involution.
+  twice = data_lib.augment_batch(out, p, np.random.default_rng(12))
+  np.testing.assert_array_equal(twice['rows'], batch['rows'])
+  np.testing.assert_array_equal(twice['label'], batch['label'])

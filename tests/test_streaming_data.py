@@ -105,3 +105,128 @@ def test_prefetch_iterator_early_close_stops_producer():
   n_after_close = len(produced)
   assert n_after_close < 50  # producer stopped, didn't drain 10k
   assert threading.active_count() < 20
+
+
+def _split_shards(testdata_dir, tmp_path, n_shards, corrupt_index=None):
+  """Re-shards the bundled train records into n_shards small shards;
+  optionally corrupts one shard mid-file."""
+  from deepconsensus_tpu.io.tfrecord import (TFRecordReader,
+                                             TFRecordWriter)
+
+  src = str(testdata_dir / 'human_1m/tf_examples/train/train.tfrecord.gz')
+  records = list(TFRecordReader(src))
+  paths = []
+  for s in range(n_shards):
+    path = str(tmp_path / f'shard-{s:02d}.tfrecord.gz')
+    with TFRecordWriter(path, compression='BGZF') as w:
+      for r in records[s::n_shards]:
+        w.write(r)
+    paths.append(path)
+  if corrupt_index is not None:
+    # Truncate rather than bit-flip: the shard payload is float tensors
+    # (incompressible -> deflate stored blocks), where a single flipped
+    # byte can decode "successfully" into corrupt data; truncation
+    # breaks framing deterministically on every decode path.
+    data = open(paths[corrupt_index], 'rb').read()
+    with open(paths[corrupt_index], 'wb') as f:
+      f.write(data[: int(len(data) * 0.7)])
+  return paths, records
+
+
+def test_streaming_workers_multishard_handoff_coverage(
+    testdata_dir, tmp_path):
+  """Workers split 6 shards 3 ways; the stream must cover EVERY shard
+  (round-robin assignment leaves no shard unread) and yield only
+  genuine examples (VERDICT r4 #8: worker-scaling correctness)."""
+  paths, records = _split_shards(testdata_dir, tmp_path, n_shards=6)
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  eager = data_lib.DatasetIterator(
+      patterns=str(tmp_path / 'shard-*.tfrecord.gz'), params=params,
+      batch_size=4, shuffle=False,
+  )
+  known = {
+      (r.tobytes(), l.tobytes())
+      for r, l in zip(eager.rows, eager.labels)
+  }
+  per_shard = {
+      s: {
+          (r.tobytes(), l.tobytes())
+          for r, l in zip(eager.rows[s::6], eager.labels[s::6])
+      }
+      for s in range(6)
+  }
+  ds = data_lib.StreamingDataset(
+      patterns=str(tmp_path / 'shard-*.tfrecord.gz'), params=params,
+      batch_size=64, buffer_size=256, workers=3, seed=3,
+  )
+  seen = set()
+  it = iter(ds)
+  try:
+    # > one epoch of records so every shard must have contributed.
+    for batch in itertools.islice(it, 2 * len(records) // 64 + 2):
+      for row, label in zip(batch['rows'], batch['label']):
+        key = (row.tobytes(), label.tobytes())
+        assert key in known
+        seen.add(key)
+  finally:
+    it.close()
+  for s, shard_keys in per_shard.items():
+    assert seen & shard_keys, f'shard {s} never contributed'
+
+
+def test_streaming_workers_corrupt_shard_fails_loudly(
+    testdata_dir, tmp_path):
+  """A corrupt shard inside a WORKER process must fail iteration (the
+  worker dies, the parent's liveness check raises) — never silently
+  shrink the dataset (VERDICT r4 #8: corrupt-shard propagation under
+  load)."""
+  import pytest
+
+  paths, records = _split_shards(testdata_dir, tmp_path, n_shards=4,
+                                 corrupt_index=2)
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  ds = data_lib.StreamingDataset(
+      patterns=str(tmp_path / 'shard-*.tfrecord.gz'), params=params,
+      batch_size=32, buffer_size=64, workers=2, seed=0,
+  )
+  it = iter(ds)
+  try:
+    with pytest.raises(Exception) as exc_info:
+      # Both workers must hit their corrupt shard within a few epochs
+      # of drain; the buffer can hide the crash for a while but not
+      # forever.
+      for _ in itertools.islice(it, 400):
+        pass
+    assert exc_info.type is not StopIteration
+  finally:
+    it.close()
+
+
+def test_streaming_workers_teardown_is_deterministic(
+    testdata_dir, tmp_path):
+  """close() must not return while worker processes are still running
+  (round-4 review: lingering workers skewed subsequent benchmark legs
+  on the 1-core host)."""
+  import multiprocessing
+  import time
+
+  _split_shards(testdata_dir, tmp_path, n_shards=2)
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  ds = data_lib.StreamingDataset(
+      patterns=str(tmp_path / 'shard-*.tfrecord.gz'), params=params,
+      batch_size=16, buffer_size=32, workers=2, seed=1,
+  )
+  it = iter(ds)
+  next(it)  # workers are up and feeding
+  assert multiprocessing.active_children()
+  t0 = time.perf_counter()
+  it.close()
+  dt = time.perf_counter() - t0
+  assert dt < 15, f'close() took {dt:.1f}s'
+  deadline = time.time() + 5
+  while multiprocessing.active_children() and time.time() < deadline:
+    time.sleep(0.1)
+  assert not multiprocessing.active_children(), 'workers outlived close()'
