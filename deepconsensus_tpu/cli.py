@@ -110,6 +110,24 @@ def _add_run(sub):
                  help='Process only ZMWs with zm %% N == I, e.g. 3/500 '
                  '— fleet scaling over one shared BAM without '
                  'splitting it.')
+  p.add_argument('--on_zmw_error', default='fail',
+                 choices=['fail', 'skip', 'ccs-fallback'],
+                 help='Per-ZMW fault policy: fail aborts the run '
+                 '(historical behavior); skip quarantines the ZMW to '
+                 '<output>.failed.jsonl; ccs-fallback additionally '
+                 'emits the draft CCS read with its original base '
+                 'qualities.')
+  p.add_argument('--batch_timeout', type=float, default=0.0,
+                 help='Watchdog timeout (s) per featurization batch '
+                 'when --cpus > 1; a hung or killed worker triggers '
+                 'pool re-spawn and retry (0 disables).')
+  p.add_argument('--batch_retries', type=int, default=2,
+                 help='Watchdog retries per featurization batch before '
+                 'the batch is quarantined.')
+  p.add_argument('--resume', action='store_true',
+                 help='Resume an interrupted run from '
+                 '<output>.progress.json + <output>.tmp, replaying the '
+                 'feeder past already-committed ZMWs.')
 
 
 def _add_train(sub):
@@ -318,6 +336,10 @@ def _dispatch(args) -> int:
         cpus=args.cpus,
         end_after_stage=args.end_after_stage,
         shard=args.shard,
+        on_zmw_error=args.on_zmw_error,
+        batch_timeout=args.batch_timeout,
+        batch_retries=args.batch_retries,
+        resume=args.resume,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal
         ),
@@ -347,7 +369,14 @@ def _dispatch(args) -> int:
       # Debug-truncated runs never stitch reads; completing the
       # requested stages is the success criterion.
       return 0
-    return 0 if counters.get('success', 0) > 0 else 1
+    # ccs-fallback emissions count as yield: a run whose every read
+    # degraded to the draft CCS still produced usable output (exit 0),
+    # while the dead-letter sidecar carries the forensic detail.
+    if counters.get('success', 0) > 0:
+      return 0
+    if counters.get('n_fallback_emitted', 0) > 0:
+      return 0
+    return 1
 
   if args.command == 'train':
     from deepconsensus_tpu.models import config as config_lib

@@ -1,0 +1,501 @@
+"""Fault-tolerance layer for the inference pipeline.
+
+The serving path polishes millions of ZMWs per run; fail-fast semantics
+(one malformed ZMW aborting the whole run, a crash at ZMW 9M losing all
+output) don't survive production traffic. This module provides:
+
+* a structured error taxonomy (stage x kind) and per-ZMW quarantine
+  governed by --on-zmw-error={fail,skip,ccs-fallback},
+* a dead-letter sidecar (<output>.failed.jsonl) recording every
+  quarantined ZMW for replay,
+* a watchdog for the featurization worker pool (per-batch timeout,
+  bounded retry/backoff, pool re-spawn, shm reclamation),
+* a resumable progress manifest for atomic <output>.tmp writes,
+* env-var fault-injection hooks driven by scripts/inject_faults.py.
+
+Counterpart of the training-side retry/resume stack
+(models/train.py run_training_with_retry); inference needs per-item
+granularity rather than restart-the-world.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import glob
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# Error taxonomy
+
+
+class FaultStage:
+  """Pipeline stage where a fault surfaced."""
+
+  DECODE = 'decode'        # BAM/BGZF stream decoding (feeder)
+  FEATURIZE = 'featurize'  # alignment expansion / pileup / windows
+  MODEL = 'model'          # device dispatch / forward pass
+  STITCH = 'stitch'        # window stitching / output formatting
+
+  ALL = (DECODE, FEATURIZE, MODEL, STITCH)
+
+
+class FaultKind:
+  TRANSIENT = 'transient'
+  PERMANENT = 'permanent'
+
+
+# Markers borrowed from the training retry loop (train.py:690-693) plus
+# host-side pool/timeout signatures.
+_TRANSIENT_MARKERS = (
+    'UNAVAILABLE', 'DEADLINE_EXCEEDED', 'RESOURCE_EXHAUSTED', 'PREEMPT',
+    'timed out', 'Timeout', 'Connection reset', 'Broken pipe',
+    'watchdog',
+)
+
+
+def classify_error(error_text: str) -> str:
+  """Transient (worth retrying) vs permanent (bad data) by message."""
+  if any(marker in error_text for marker in _TRANSIENT_MARKERS):
+    return FaultKind.TRANSIENT
+  return FaultKind.PERMANENT
+
+
+class OnZmwError:
+  """--on-zmw-error policy values."""
+
+  FAIL = 'fail'
+  SKIP = 'skip'
+  CCS_FALLBACK = 'ccs-fallback'
+
+  CHOICES = (FAIL, SKIP, CCS_FALLBACK)
+
+
+@dataclasses.dataclass
+class ZmwFault(Exception):
+  """A classified per-ZMW failure."""
+
+  zmw: Optional[str]
+  stage: str
+  kind: str
+  message: str
+
+  def __str__(self) -> str:
+    return (
+        f'[{self.stage}/{self.kind}] zmw={self.zmw or "<stream>"}: '
+        f'{self.message}'
+    )
+
+
+class WatchdogTimeout(RuntimeError):
+  """A featurization batch exhausted its watchdog retries."""
+
+
+# ----------------------------------------------------------------------
+# CCS fallback payloads
+
+
+@dataclasses.dataclass
+class CcsFallback:
+  """The draft CCS read emitted in place of a quarantined ZMW so yield
+  degrades gracefully instead of the read (or run) disappearing."""
+
+  molecule_name: str
+  sequence: str
+  quality_scores: np.ndarray  # int array, one per base
+  ec: Optional[float] = None
+  np_num_passes: Optional[int] = None
+  rq: Optional[float] = None
+  rg: Optional[str] = None
+
+
+def fallback_from_record(record) -> CcsFallback:
+  """Builds a fallback from a raw ccs BamRecord (feeder stage)."""
+  n = len(record.seq)
+  quals = (
+      np.asarray(record.quals, dtype=np.int64)
+      if record.quals is not None else np.zeros(n, dtype=np.int64)
+  )
+  tags = record.tags
+  return CcsFallback(
+      molecule_name=record.qname,
+      sequence=record.seq,
+      quality_scores=quals,
+      ec=tags.get('ec'),
+      np_num_passes=tags.get('np'),
+      rq=tags.get('rq'),
+      rg=tags.get('RG'),
+  )
+
+
+def fallback_from_ccs_read(ccs_read) -> CcsFallback:
+  """Builds a fallback from an expanded AlignedRead draft CCS
+  (featurize stage: zmw_input's subreads[-1])."""
+  from deepconsensus_tpu.utils import phred
+
+  return CcsFallback(
+      molecule_name=ccs_read.name,
+      sequence=phred.encoded_sequence_to_string(ccs_read.bases),
+      quality_scores=np.asarray(ccs_read.base_quality_scores,
+                                dtype=np.int64),
+      ec=ccs_read.ec,
+      np_num_passes=ccs_read.np_num_passes,
+      rq=ccs_read.rq,
+      rg=ccs_read.rg,
+  )
+
+
+# ----------------------------------------------------------------------
+# Dead-letter sidecar
+
+
+class DeadLetterWriter:
+  """Streams quarantined-ZMW records to <output>.failed.jsonl.
+
+  One JSON object per line: {zmw, stage, kind, error, action, time}.
+  The file is created lazily on the first record so clean runs leave no
+  empty sidecar; every line is flushed so a later crash can't lose the
+  forensic trail. Replay: feed the recorded zmw ids back through
+  --shard-style filtering or scripts/inject_faults.py.
+  """
+
+  def __init__(self, path: str, append: bool = False):
+    self.path = path
+    self._append = append
+    self._f = None
+    self.count = 0
+
+  def record(self, zmw: Optional[str], stage: str, kind: str, error: str,
+             action: str) -> None:
+    if self._f is None:
+      self._f = open(self.path, 'a' if self._append else 'w')
+    json.dump(
+        {
+            'zmw': zmw,
+            'stage': stage,
+            'kind': kind,
+            'error': error[:4000],
+            'action': action,
+            'time': time.time(),
+        },
+        self._f,
+    )
+    self._f.write('\n')
+    self._f.flush()
+    self.count += 1
+
+  def close(self) -> None:
+    if self._f is not None:
+      self._f.close()
+      self._f = None
+
+
+def read_dead_letters(path: str) -> List[Dict[str, Any]]:
+  """Parses a dead-letter sidecar back into records (for replay)."""
+  entries = []
+  with open(path) as f:
+    for line in f:
+      line = line.strip()
+      if line:
+        entries.append(json.loads(line))
+  return entries
+
+
+# ----------------------------------------------------------------------
+# Quarantine
+
+
+class Quarantine:
+  """Applies the --on-zmw-error policy to per-ZMW faults.
+
+  handle() re-raises under the 'fail' policy; otherwise it records a
+  dead letter, bumps counters, and returns the CcsFallback to emit (or
+  None). Thread-safe: the producer thread (feeder/featurize) and the
+  consumer thread (model/stitch) both report faults.
+  """
+
+  def __init__(self, policy: str, dead_letter: Optional[DeadLetterWriter]):
+    if policy not in OnZmwError.CHOICES:
+      raise ValueError(
+          f'on_zmw_error must be one of {OnZmwError.CHOICES}, '
+          f'got {policy!r}'
+      )
+    self.policy = policy
+    self.dead_letter = dead_letter
+    self.counters: collections.Counter = collections.Counter()
+    self._lock = threading.Lock()
+
+  def handle(
+      self,
+      zmw: Optional[str],
+      stage: str,
+      error: BaseException | str,
+      fallback: Optional[Callable[[], Optional[CcsFallback]]] = None,
+  ) -> Optional[CcsFallback]:
+    """Quarantines one ZMW. fallback is a thunk (evaluated only under
+    the ccs-fallback policy) producing the draft-CCS payload, or None
+    when no draft is recoverable (the quarantine downgrades to skip)."""
+    if self.policy == OnZmwError.FAIL:
+      if isinstance(error, BaseException):
+        raise error
+      raise ZmwFault(zmw, stage, classify_error(error), error)
+    text = (
+        error if isinstance(error, str)
+        else f'{type(error).__name__}: {error}'
+    )
+    kind = classify_error(text)
+    payload = None
+    action = OnZmwError.SKIP
+    if self.policy == OnZmwError.CCS_FALLBACK and fallback is not None:
+      try:
+        payload = fallback()
+      except Exception as fb_err:  # fallback itself unrecoverable
+        log.warning('ccs-fallback for %s failed (%s); skipping', zmw, fb_err)
+      if payload is not None:
+        action = OnZmwError.CCS_FALLBACK
+    with self._lock:
+      self.counters['n_zmw_quarantined'] += 1
+      self.counters[f'n_fault_{stage}'] += 1
+      if action == OnZmwError.CCS_FALLBACK:
+        self.counters['n_zmw_ccs_fallback'] += 1
+      else:
+        self.counters['n_zmw_skipped_on_error'] += 1
+      if self.dead_letter is not None:
+        self.dead_letter.record(zmw, stage, kind, text, action)
+    log.warning('quarantined zmw=%s stage=%s kind=%s action=%s: %s',
+                zmw, stage, kind, action, text.splitlines()[-1] if text
+                else text)
+    return payload
+
+  def bump(self, key: str, n: int = 1) -> None:
+    with self._lock:
+      self.counters[key] += n
+
+
+# ----------------------------------------------------------------------
+# Worker-pool watchdog
+
+
+def reclaim_shm_segments(prefix: str) -> int:
+  """Unlinks every /dev/shm segment carrying this run/batch prefix —
+  the only owner record left after a worker was SIGKILLed (the worker
+  unregisters its segments from its resource tracker before handing
+  ownership to the parent)."""
+  if not prefix:
+    return 0
+  n = 0
+  for path in glob.glob(f'/dev/shm/{glob.escape(prefix)}*'):
+    try:
+      os.unlink(path)
+      n += 1
+    except OSError:
+      pass
+  if n:
+    log.warning('reclaimed %d leaked shm segment(s) with prefix %s',
+                n, prefix)
+  return n
+
+
+class PoolWatchdog:
+  """Supervises the featurization multiprocessing.Pool.
+
+  run_batch() bounds each starmap with a timeout; a hung or SIGKILLed
+  worker (multiprocessing.Pool silently loses the in-flight task when a
+  worker dies, so its result never arrives) surfaces as a timeout. The
+  watchdog then reclaims the batch's shm segments, terminates and
+  re-spawns the pool, backs off, and retries the whole batch; after
+  `retries` failed retries it raises WatchdogTimeout for the quarantine
+  layer to apply the --on-zmw-error policy.
+  """
+
+  # Pool-machinery failures that merit a respawn/retry like a timeout.
+  _POOL_ERRORS = (BrokenPipeError, EOFError, ConnectionError)
+
+  def __init__(
+      self,
+      make_pool: Callable[[], Any],
+      timeout: float = 0.0,
+      retries: int = 2,
+      backoff: float = 0.5,
+      quarantine: Optional[Quarantine] = None,
+  ):
+    self._make_pool = make_pool
+    self.timeout = timeout
+    self.retries = max(0, retries)
+    self.backoff = backoff
+    self.quarantine = quarantine
+    self.pool = make_pool()
+
+  def _bump(self, key: str) -> None:
+    if self.quarantine is not None:
+      self.quarantine.bump(key)
+
+  def run_batch(self, func, tasks, chunksize: int, shm_prefix: str = ''):
+    """starmap with watchdog semantics; returns the results list."""
+    import multiprocessing
+
+    if not self.timeout:
+      return self.pool.starmap(func, tasks, chunksize=chunksize)
+    last_error = 'timeout'
+    for attempt in range(self.retries + 1):
+      if attempt:
+        self._bump('n_watchdog_retries')
+        time.sleep(self.backoff * (2 ** (attempt - 1)))
+      async_result = self.pool.starmap_async(
+          func, tasks, chunksize=chunksize
+      )
+      try:
+        return async_result.get(self.timeout)
+      except multiprocessing.TimeoutError:
+        last_error = f'no result within {self.timeout}s'
+      except self._POOL_ERRORS as e:
+        last_error = f'pool failure: {type(e).__name__}: {e}'
+      self._bump('n_watchdog_timeouts')
+      log.warning(
+          'featurization batch watchdog fired (attempt %d/%d): %s; '
+          're-spawning the worker pool',
+          attempt + 1, self.retries + 1, last_error,
+      )
+      self.respawn(shm_prefix)
+    raise WatchdogTimeout(
+        f'featurization batch failed the watchdog {self.retries + 1} '
+        f'time(s): {last_error}'
+    )
+
+  def respawn(self, shm_prefix: str = '') -> None:
+    """Terminates the (possibly hung) pool, reclaims this batch's shm
+    segments, and brings up a fresh pool."""
+    try:
+      self.pool.terminate()
+      self.pool.join()
+    except Exception as e:  # pragma: no cover - teardown best-effort
+      log.warning('pool terminate failed: %s', e)
+    reclaim_shm_segments(shm_prefix)
+    self._bump('n_pool_respawns')
+    self.pool = self._make_pool()
+
+  def close(self) -> None:
+    try:
+      self.pool.close()
+      self.pool.join()
+    except Exception:  # pragma: no cover - teardown best-effort
+      self.pool.terminate()
+      self.pool.join()
+
+
+# ----------------------------------------------------------------------
+# Resumable, atomic output
+
+
+class ProgressManifest:
+  """Crash-consistent progress record for <output>.tmp.
+
+  Commits are atomic (write + rename) and record the number of feeder
+  groups fully written plus the flushed tmp-file size, so --resume can
+  truncate the tmp output to the last committed byte and skip exactly
+  the committed ZMW groups. `source` pins the input identity; resuming
+  against a different input fails loudly.
+  """
+
+  VERSION = 1
+
+  def __init__(self, path: str):
+    self.path = path
+
+  def commit(self, groups_done: int, tmp_size: int,
+             source: Dict[str, Any], last_zmw: Optional[str] = None,
+             extra: Optional[Dict[str, Any]] = None) -> None:
+    state = {
+        'version': self.VERSION,
+        'groups_done': groups_done,
+        'tmp_size': tmp_size,
+        'last_zmw': last_zmw,
+        'source': source,
+        'time': time.time(),
+    }
+    if extra:
+      state.update(extra)
+    tmp = self.path + '.tmp'
+    with open(tmp, 'w') as f:
+      json.dump(state, f)
+      f.flush()
+      os.fsync(f.fileno())
+    os.replace(tmp, self.path)
+
+  def load(self) -> Optional[Dict[str, Any]]:
+    try:
+      with open(self.path) as f:
+        state = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+      return None
+    if state.get('version') != self.VERSION:
+      log.warning('ignoring %s with unknown version %s', self.path,
+                  state.get('version'))
+      return None
+    return state
+
+  def delete(self) -> None:
+    for path in (self.path, self.path + '.tmp'):
+      try:
+        os.unlink(path)
+      except FileNotFoundError:
+        pass
+
+
+def validate_resume_source(state: Dict[str, Any],
+                           source: Dict[str, Any]) -> None:
+  """A manifest written for different inputs/options must not silently
+  graft a resumed run onto them."""
+  recorded = state.get('source') or {}
+  for key, value in source.items():
+    if recorded.get(key) != value:
+      raise ValueError(
+          f'--resume manifest mismatch for {key!r}: run was started '
+          f'with {recorded.get(key)!r}, resume requested {value!r} '
+          f'(delete the .progress.json to restart from scratch)'
+      )
+
+
+# ----------------------------------------------------------------------
+# Fault-injection hooks (driven by scripts/inject_faults.py + tests)
+
+ENV_KILL_ZMW = 'DCTPU_FAULT_KILL_ZMW'
+ENV_KILL_TOKEN = 'DCTPU_FAULT_KILL_TOKEN'
+ENV_CRASH_AFTER_BATCHES = 'DCTPU_FAULT_CRASH_AFTER_BATCHES'
+
+
+def maybe_kill_worker(zmw_name: str) -> None:
+  """SIGKILLs the current process when fault injection targets this
+  ZMW. With ENV_KILL_TOKEN set, the kill fires exactly once (the first
+  worker to create the token file dies; retries then succeed) so the
+  watchdog's recovery is observable rather than an infinite loop."""
+  target = os.environ.get(ENV_KILL_ZMW)
+  if not target or target != zmw_name:
+    return
+  token = os.environ.get(ENV_KILL_TOKEN)
+  if token:
+    try:
+      fd = os.open(token, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+      return
+    os.close(fd)
+  import signal
+
+  os.kill(os.getpid(), signal.SIGKILL)
+
+
+def injected_crash_after_batches() -> int:
+  """>0: the consumer loop raises after this many consumed batches."""
+  try:
+    return int(os.environ.get(ENV_CRASH_AFTER_BATCHES, '0'))
+  except ValueError:
+    return 0

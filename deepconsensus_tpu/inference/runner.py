@@ -22,6 +22,7 @@ import dataclasses
 import itertools
 import json
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -30,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.inference import faults
 from deepconsensus_tpu.io import bam as bam_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import data as data_lib
@@ -80,6 +82,18 @@ class InferenceOptions:
   # compute of batch i. Device-side cost per in-flight batch is one
   # uint8 input buffer (~21 MB at b1024) + tiny outputs.
   dispatch_depth: int = 8
+  # Fault tolerance (inference/faults.py). on_zmw_error governs the
+  # per-ZMW quarantine: 'fail' keeps historical fail-fast semantics,
+  # 'skip' drops the ZMW (dead-lettered), 'ccs-fallback' emits the
+  # draft CCS read with its original base qualities instead.
+  on_zmw_error: str = 'fail'  # fail | skip | ccs-fallback
+  # >0: per-batch watchdog timeout (s) on the featurization pool; a
+  # hung/SIGKILLed worker surfaces as a timeout, triggering pool
+  # re-spawn + bounded retry (batch_retries) before quarantine.
+  batch_timeout: float = 0.0
+  batch_retries: int = 2
+  # Resume an interrupted run from <output>.progress.json + <output>.tmp.
+  resume: bool = False
   # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
   end_after_stage: str = 'full'  # dc_input | tf_examples | run_model | full
   dc_calibration_values: calibration_lib.QualityCalibrationValues = (
@@ -384,7 +398,24 @@ _SHM_META_FIELDS = (
 )
 
 
-def preprocess_zmw_shm(zmw_input, options: InferenceOptions):
+def _create_shm(size: int, prefix: Optional[str] = None):
+  """One shm segment, named under `prefix` when given so the watchdog
+  can reclaim a killed worker's orphans by glob (faults
+  .reclaim_shm_segments) without touching other batches' segments."""
+  from multiprocessing import shared_memory
+
+  if not prefix:
+    return shared_memory.SharedMemory(create=True, size=size)
+  for attempt in itertools.count():
+    name = f'{prefix}{os.getpid()}_{attempt}'
+    try:
+      return shared_memory.SharedMemory(name=name, create=True, size=size)
+    except FileExistsError:
+      continue
+
+
+def preprocess_zmw_shm(zmw_input, options: InferenceOptions,
+                       shm_prefix: Optional[str] = None):
   """Pool-worker variant: the bulk window tensors travel through one
   POSIX shared-memory segment per ZMW instead of the result pickle.
 
@@ -394,14 +425,14 @@ def preprocess_zmw_shm(zmw_input, options: InferenceOptions):
   parent re-views the tensors with _features_from_shm and owns the
   segment's lifetime (workers unregister from their resource tracker).
   """
-  from multiprocessing import resource_tracker, shared_memory
+  from multiprocessing import resource_tracker
 
   features, counter = preprocess_zmw(zmw_input, options)
   total = sum(f['subreads'].nbytes for f in features)
   if not total:
     return None, [{k: f[k] for k in _SHM_META_FIELDS} for f in features
                   ], counter
-  shm = shared_memory.SharedMemory(create=True, size=total)
+  shm = _create_shm(total, shm_prefix)
   try:
     meta = []
     offset = 0
@@ -437,12 +468,16 @@ def preprocess_zmw_shm(zmw_input, options: InferenceOptions):
   return name, meta, counter
 
 
-def _pool_worker(zmw_input, options: InferenceOptions):
+def _pool_worker(zmw_input, options: InferenceOptions,
+                 shm_prefix: Optional[str] = None):
   """starmap payload: never raises, so the parent always receives every
   created shm name (a raising task would make starmap discard ALL
   results, orphaning the successful workers' segments forever)."""
   try:
-    return 'ok', preprocess_zmw_shm(zmw_input, options)
+    name = zmw_input[1] if len(zmw_input) > 1 else None
+    if isinstance(name, str):
+      faults.maybe_kill_worker(name)
+    return 'ok', preprocess_zmw_shm(zmw_input, options, shm_prefix)
   except BaseException:
     import traceback
 
@@ -586,7 +621,17 @@ def run_inference(
     mesh=None,
 ) -> Dict[str, Any]:
   """Full inference pipeline; returns the counters dict
-  (reference run(): quick_inference.py:794-963)."""
+  (reference run(): quick_inference.py:794-963).
+
+  Fault tolerance (inference/faults.py): with options.on_zmw_error !=
+  'fail', per-ZMW failures in any stage are quarantined to
+  <output>.failed.jsonl — optionally emitting the draft CCS read —
+  instead of aborting the run; the featurization pool runs under a
+  watchdog (batch_timeout/batch_retries); and output streams into
+  <output>.tmp with a crash-consistent progress manifest, renamed into
+  place only on success. options.resume replays the feeder past the
+  committed groups of an interrupted run.
+  """
   options = options or InferenceOptions()
   if runner is None:
     if checkpoint is None:
@@ -596,6 +641,52 @@ def run_inference(
   options.max_passes = params.max_passes
   options.max_length = params.max_length
   options.use_ccs_bq = params.use_ccs_bq
+
+  fail_fast = options.on_zmw_error == faults.OnZmwError.FAIL
+  dead_letter: Optional[faults.DeadLetterWriter] = None
+  quarantine: Optional[faults.Quarantine] = None
+
+  # Atomic, resumable output: everything streams into <output>.tmp; the
+  # manifest records (feeder groups committed, flushed tmp size) after
+  # every consumed batch, and the tmp file is renamed into place only
+  # when the run completes. A crashed run never leaves a plausible-
+  # looking final output, and --resume truncates the tmp file to the
+  # last committed byte and replays the feeder past committed groups.
+  manifest = faults.ProgressManifest(output + '.progress.json')
+  source = {
+      'subreads_to_ccs': subreads_to_ccs,
+      'ccs_bam': ccs_bam,
+      'ccs_fasta': ccs_fasta,
+      'output': output,
+      'shard': list(options.shard) if options.shard else None,
+  }
+  out_tmp = output + '.tmp'
+  resume_skip_groups = 0
+  resuming = False
+  if options.resume and options.end_after_stage == 'full':
+    state = manifest.load()
+    if state is None:
+      log.info('--resume: no usable progress manifest; starting fresh')
+    else:
+      faults.validate_resume_source(state, source)
+      committed = int(state['tmp_size'])
+      if os.path.exists(out_tmp) and os.path.getsize(out_tmp) >= committed:
+        with open(out_tmp, 'r+b') as f:
+          f.truncate(committed)
+        resume_skip_groups = int(state['groups_done'])
+        resuming = True
+        log.info(
+            'resuming after %d committed feeder group(s); %s truncated '
+            'to %d bytes', resume_skip_groups, out_tmp, committed)
+      else:
+        log.warning(
+            '--resume: %s missing or shorter than the committed %d '
+            'bytes; restarting from scratch', out_tmp, committed)
+
+  if not fail_fast:
+    dead_letter = faults.DeadLetterWriter(output + '.failed.jsonl',
+                                          append=resuming)
+    quarantine = faults.Quarantine(options.on_zmw_error, dead_letter)
 
   layout = FeatureLayout(
       max_passes=options.max_passes,
@@ -611,15 +702,26 @@ def run_inference(
       use_ccs_smart_windows=options.use_ccs_smart_windows,
       limit=options.limit,
       shard=options.shard,
+      quarantine=quarantine,
+      resume_skip_groups=resume_skip_groups,
   )
-  pool = None
+  watchdog: Optional[faults.PoolWatchdog] = None
   if (options.cpus and options.cpus > 1
       and options.end_after_stage != 'dc_input'):
     # dc_input runs never featurize; forking idle workers would only
     # pollute the stage timing the flag exists to measure.
     import multiprocessing
 
-    pool = multiprocessing.Pool(options.cpus)
+    watchdog = faults.PoolWatchdog(
+        lambda: multiprocessing.Pool(options.cpus),
+        timeout=options.batch_timeout,
+        retries=options.batch_retries,
+        quarantine=quarantine,
+    )
+  # Per-batch shm namespace: pool segments are created under
+  # <run>b<seq>_ so a SIGKILLed worker's orphans can be reclaimed by
+  # prefix without touching other in-flight batches' segments.
+  shm_run_prefix = f'dctpu_{os.getpid()}_'
   outcome = stitch.OutcomeCounter()
   window_counter: collections.Counter = collections.Counter()
   timing_rows: List[Dict[str, Any]] = []
@@ -640,7 +742,7 @@ def run_inference(
           header_text = ccs_reader.header_text
           if not header_text.endswith('\n'):
             header_text += '\n'
-    writer = BamWriter(output, header_text=header_text)
+    writer = BamWriter(out_tmp, header_text=header_text, append=resuming)
 
     def emit(fastq_str: str, dc_outputs) -> None:
       name, seq, _, qual = fastq_str.rstrip('\n').split('\n')
@@ -654,7 +756,14 @@ def run_inference(
         tags['rq'] = float(first.rq)
       if first.rg is not None:
         tags['RG'] = str(first.rg)
-      tags['zm'] = int(name[1:].split('/')[1])
+      # Non-PacBio names (e.g. ccs_fasta inputs with plain names) have
+      # no movie/zmw/type structure; omit the zm tag rather than crash.
+      parts = name[1:].split('/')
+      if len(parts) >= 2:
+        try:
+          tags['zm'] = int(parts[1])
+        except ValueError:
+          pass
       writer.write(
           name[1:],
           seq,
@@ -663,250 +772,418 @@ def run_inference(
       )
 
     close_out = writer.close
+    sink_flush = writer.flush
+    sink_tell = writer.tell
   else:
-    writer = open(output, 'w')
+    writer = open(out_tmp, 'ab' if resuming else 'wb')
 
     def emit(fastq_str: str, dc_outputs) -> None:
       del dc_outputs
-      writer.write(fastq_str)
+      writer.write(fastq_str.encode('ascii'))
 
     close_out = writer.close
+    sink_flush = writer.flush
+    sink_tell = writer.tell
 
+  partial = True
+  counters: Dict[str, Any] = {}
   try:
+    try:
 
-    def featurize_batch(zmw_batch):
-      """Producer-side: BAM records -> window features for one batch."""
-      t0 = time.time()
-      all_windows: List[Dict[str, Any]] = []
-      zmw_counters = []
-      shm_handles = []
-      n_subreads = 0
-      if pool is not None:
-        # Bulk tensors travel via shared memory; the result pickle
-        # carries only names/offsets (the pipe was the bottleneck).
-        # _pool_worker never raises, so starmap always returns and the
-        # parent always sees every created shm name (a raising task
-        # would discard ALL results, orphaning sibling segments).
-        raw = pool.starmap(
-            _pool_worker, [(z, options) for z in zmw_batch], chunksize=4,
-        )
-        results = []
-        try:
-          for status, payload in raw:
-            if status != 'ok':
-              raise RuntimeError(
-                  f'featurization worker failed:\n{payload}'
-              )
-            features, zmw_counter, shm = _features_from_shm(payload)
-            results.append((features, zmw_counter))
-            if shm is not None:
-              shm_handles.append(shm)
-        except BaseException:
-          # Workers unregistered the segments from their resource
-          # tracker, so this is the only cleanup: unlink every segment
-          # named in raw (attached or not) before propagating.
-          from multiprocessing import shared_memory
+      def featurize_batch(zmw_batch, shm_prefix=''):
+        """Producer-side: BAM records -> window features for one batch."""
+        t0 = time.time()
+        fallbacks = [
+            z for z in zmw_batch if isinstance(z, faults.CcsFallback)
+        ]
+        zmws = [
+            z for z in zmw_batch if not isinstance(z, faults.CcsFallback)
+        ]
+        all_windows: List[Dict[str, Any]] = []
+        zmw_counters = []
+        shm_handles = []
+        n_subreads = 0
+        pairs = []  # (zmw_input, features, per-zmw counter)
 
-          attached = {s.name for s in shm_handles}
-          for shm in shm_handles:
-            try:
-              shm.close()
-              shm.unlink()
-            except OSError:
-              pass
-          for status, payload in raw:
-            if (status == 'ok' and payload[0] is not None
-                and payload[0] not in attached):
+        def quarantine_featurize(zmw_input, error):
+          ccs_read = zmw_input[0][-1]
+          item = quarantine.handle(
+              zmw_input[1], 'featurize', error,
+              fallback=lambda r=ccs_read: faults.fallback_from_ccs_read(r),
+          )
+          if item is not None:
+            fallbacks.append(item)
+
+        if watchdog is not None:
+          # Bulk tensors travel via shared memory; the result pickle
+          # carries only names/offsets (the pipe was the bottleneck).
+          # _pool_worker never raises, so starmap always returns and the
+          # parent always sees every created shm name (a raising task
+          # would discard ALL results, orphaning sibling segments).
+          try:
+            raw = watchdog.run_batch(
+                _pool_worker,
+                [(z, options, shm_prefix) for z in zmws],
+                chunksize=4,
+                shm_prefix=shm_prefix,
+            )
+          except faults.WatchdogTimeout as e:
+            if quarantine is None:
+              raise
+            # The whole batch exhausted the watchdog; quarantine every
+            # ZMW in it (the pool is already re-spawned and the batch's
+            # shm segments reclaimed).
+            for z in zmws:
+              quarantine_featurize(z, e)
+            raw = []
+          try:
+            for zmw_input, (status, payload) in zip(zmws, raw):
+              if status != 'ok':
+                if quarantine is None:
+                  raise RuntimeError(
+                      f'featurization worker failed:\n{payload}'
+                  )
+                quarantine_featurize(
+                    zmw_input,
+                    f'featurization worker failed:\n{payload}',
+                )
+                continue
+              features, zmw_counter, shm = _features_from_shm(payload)
+              pairs.append((zmw_input, features, zmw_counter))
+              if shm is not None:
+                shm_handles.append(shm)
+          except BaseException:
+            # Workers unregistered the segments from their resource
+            # tracker, so this is the only cleanup: unlink every segment
+            # named in raw (attached or not) before propagating.
+            from multiprocessing import shared_memory
+
+            attached = {s.name for s in shm_handles}
+            for shm in shm_handles:
               try:
-                leaked = shared_memory.SharedMemory(name=payload[0])
-                leaked.close()
-                leaked.unlink()
+                shm.close()
+                shm.unlink()
               except OSError:
                 pass
-          raise
-      else:
-        results = (preprocess_zmw(z, options) for z in zmw_batch)
-      for zmw_input, (features, zmw_counter) in zip(zmw_batch, results):
-        n_subreads += len(zmw_input[0]) - 1
-        zmw_counters.append(zmw_counter)
-        all_windows.extend(features)
-      return {
-          'windows': all_windows,
-          'counters': zmw_counters,
-          'n_subreads': n_subreads,
-          'n_zmws': len(zmw_batch),
-          'preprocess_time': time.time() - t0,
-          'shm_handles': shm_handles,
-      }
+            for status, payload in raw:
+              if (status == 'ok' and payload[0] is not None
+                  and payload[0] not in attached):
+                try:
+                  leaked = shared_memory.SharedMemory(name=payload[0])
+                  leaked.close()
+                  leaked.unlink()
+                except OSError:
+                  pass
+            faults.reclaim_shm_segments(shm_prefix)
+            raise
+        else:
+          for z in zmws:
+            try:
+              features, zmw_counter = preprocess_zmw(z, options)
+            except Exception as e:
+              if quarantine is None:
+                raise
+              quarantine_featurize(z, e)
+              continue
+            pairs.append((z, features, zmw_counter))
+        for zmw_input, features, zmw_counter in pairs:
+          n_subreads += len(zmw_input[0]) - 1
+          zmw_counters.append(zmw_counter)
+          all_windows.extend(features)
+        return {
+            'windows': all_windows,
+            'counters': zmw_counters,
+            'n_subreads': n_subreads,
+            'n_zmws': len(zmw_batch),
+            'preprocess_time': time.time() - t0,
+            'shm_handles': shm_handles,
+            'fallbacks': fallbacks,
+        }
 
-    def release_shm(feat):
-      for shm in feat.get('shm_handles', ()):
-        try:
-          shm.close()
-          shm.unlink()
-        except (FileNotFoundError, OSError):
-          pass
-      feat['shm_handles'] = []
+      def release_shm(feat):
+        for shm in feat.get('shm_handles', ()):
+          try:
+            shm.close()
+            shm.unlink()
+          except (FileNotFoundError, OSError):
+            pass
+        feat['shm_handles'] = []
 
-    def consume_batch(feat):
-      try:
-        _consume_batch(feat)
-      finally:
-        release_shm(feat)
-
-    def _consume_batch(feat):
-      nonlocal fastq_lines
-      all_windows = feat['windows']
-      n_subreads = feat['n_subreads']
-      n_batch_zmws = feat['n_zmws']
-      for zmw_counter in feat['counters']:
-        window_counter.update(zmw_counter)
-      t1 = time.time()
-      if options.end_after_stage == 'tf_examples':
-        timing_rows.append(
-            dict(stage='preprocess', runtime=feat['preprocess_time'],
-                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
-                 n_subreads=n_subreads))
-        return
-      to_model, to_skip = _triage_windows(all_windows, options,
-                                          window_counter)
-      predictions = [
-          process_skipped_window(fd, options) for fd in to_skip
-      ]
-      predictions.extend(
-          run_model_on_windows(to_model, runner, params, options)
-      )
-      t2 = time.time()
-      if options.end_after_stage == 'run_model':
-        timing_rows.append(
-            dict(stage='run_model', runtime=t2 - t1,
-                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
-                 n_subreads=n_subreads))
-        return
-      predictions.sort(key=lambda p: (p.molecule_name, p.window_pos))
-      for name, group in itertools.groupby(
-          predictions, key=lambda p: p.molecule_name
-      ):
-        group = list(group)
-        fastq = stitch.stitch_to_fastq(
-            molecule_name=name,
-            predictions=group,
-            max_length=options.max_length,
+      def emit_fallback(fb) -> None:
+        """Emits a quarantined ZMW's draft CCS read (ccs-fallback)."""
+        nonlocal fastq_lines
+        fastq = stitch.fallback_to_fastq(
+            fb.molecule_name,
+            fb.sequence,
+            fb.quality_scores,
             min_quality=options.min_quality,
             min_length=options.min_length,
-            outcome_counter=outcome,
+            max_base_quality=options.max_base_quality,
+            counter=window_counter,
         )
-        if fastq is not None:
-          emit(fastq, group)
-          fastq_lines += 1
-      t3 = time.time()
-      timing_rows.extend([
-          dict(stage='preprocess', runtime=feat['preprocess_time'],
-               n_zmws=n_batch_zmws, n_examples=len(all_windows),
-               n_subreads=n_subreads),
-          dict(stage='run_model', runtime=t2 - t1, n_zmws=n_batch_zmws,
-               n_examples=len(all_windows), n_subreads=n_subreads),
-          dict(stage='stitch_and_write_fastq', runtime=t3 - t2,
-               n_zmws=n_batch_zmws, n_examples=len(all_windows),
-               n_subreads=n_subreads),
-      ])
-
-    # Cross-batch pipelining: a producer thread reads BAMs and
-    # featurizes batch N+1 while the main thread runs batch N through
-    # the model and stitcher. Counter discipline: the producer owns the
-    # feeder's `counter`; the main thread accumulates into
-    # `window_counter` and the two merge after join.
-    import queue as queue_lib
-    import threading
-
-    feat_queue: 'queue_lib.Queue' = queue_lib.Queue(maxsize=2)
-    stop = threading.Event()
-    skip_featurize = options.end_after_stage == 'dc_input'
-
-    def put(item) -> bool:
-      """Bounded put that aborts when the consumer has bailed."""
-      while not stop.is_set():
-        try:
-          feat_queue.put(item, timeout=0.5)
-          return True
-        except queue_lib.Full:
-          continue
-      return False
-
-    def producer():
-      try:
-        def flush(zmw_batch) -> bool:
-          if not zmw_batch:
-            return True
-          if skip_featurize:
-            # dc_input stage: measure BAM decode/grouping only, so the
-            # runtime CSV still carries one row per batch.
-            timing_rows.append(
-                dict(stage='dc_input',
-                     runtime=time.time() - flush.t_start,
-                     n_zmws=len(zmw_batch), n_examples=0,
-                     n_subreads=sum(len(z[0]) - 1 for z in zmw_batch)))
-            flush.t_start = time.time()
-            return True
-          feat = featurize_batch(zmw_batch)
-          ok = put(('batch', feat))
-          if not ok:
-            # Consumer bailed mid-flight: this batch will never be
-            # consumed, and its shm segments have no other owner.
-            release_shm(feat)
-          return ok
-
-        flush.t_start = time.time()
-        zmw_batch = []
-        for zmw_input in feeder():
-          zmw_batch.append(zmw_input)
-          if options.batch_zmws and len(zmw_batch) >= options.batch_zmws:
-            if not flush(zmw_batch):
-              return
-            zmw_batch = []
-        if not flush(zmw_batch):
+        if fastq is None:
           return
-        put(('done', None))
-      except BaseException as e:  # surface worker failures to the main thread
-        put(('error', e))
+        emit(fastq, [stitch.DCModelOutput(
+            molecule_name=fb.molecule_name, window_pos=0, ec=fb.ec,
+            np_num_passes=fb.np_num_passes, rq=fb.rq, rg=fb.rg)])
+        fastq_lines += 1
 
-    thread = threading.Thread(target=producer, daemon=True)
-    thread.start()
-    try:
-      while True:
-        kind, payload = feat_queue.get()
-        if kind == 'done':
-          break
-        if kind == 'error':
-          raise payload
-        consume_batch(payload)
+      def consume_batch(feat):
+        try:
+          _consume_batch(feat)
+        finally:
+          release_shm(feat)
+        if options.end_after_stage == 'full' and 'groups_end' in feat:
+          # Durability point: flush the sink so the manifest's
+          # (groups_done, tmp_size) pair names a valid output prefix
+          # that --resume can truncate back to.
+          sink_flush()
+          manifest.commit(
+              groups_done=feat['groups_end'],
+              tmp_size=sink_tell(),
+              source=source,
+              last_zmw=feat.get('last_zmw'),
+          )
+
+      def _consume_batch(feat):
+        nonlocal fastq_lines
+        all_windows = feat['windows']
+        n_subreads = feat['n_subreads']
+        n_batch_zmws = feat['n_zmws']
+        for zmw_counter in feat['counters']:
+          window_counter.update(zmw_counter)
+        t1 = time.time()
+        if options.end_after_stage == 'tf_examples':
+          timing_rows.append(
+              dict(stage='preprocess', runtime=feat['preprocess_time'],
+                   n_zmws=n_batch_zmws, n_examples=len(all_windows),
+                   n_subreads=n_subreads))
+          return
+        to_model, to_skip = _triage_windows(all_windows, options,
+                                            window_counter)
+        predictions = [
+            process_skipped_window(fd, options) for fd in to_skip
+        ]
+        try:
+          predictions.extend(
+              run_model_on_windows(to_model, runner, params, options)
+          )
+        except Exception as e:
+          if quarantine is None:
+            raise
+          # Per-ZMW degradation of a model-stage failure: adopt the CCS
+          # bases/qualities for each affected molecule's windows
+          # (ccs-fallback) or drop those molecules entirely (skip).
+          def mol(fd):
+            return (fd['name'] if isinstance(fd['name'], str)
+                    else fd['name'].decode())
+
+          dropped = set()
+          for name, fds in itertools.groupby(
+              sorted(to_model, key=mol), key=mol):
+            fds = list(fds)
+            adopted = quarantine.handle(
+                name, 'model', e,
+                fallback=lambda fds=fds: [
+                    process_skipped_window(fd, options) for fd in fds
+                ],
+            )
+            if adopted:
+              predictions.extend(adopted)
+            else:
+              dropped.add(name)
+          if dropped:
+            predictions = [
+                p for p in predictions if p.molecule_name not in dropped
+            ]
+        t2 = time.time()
+        if options.end_after_stage == 'run_model':
+          timing_rows.append(
+              dict(stage='run_model', runtime=t2 - t1,
+                   n_zmws=n_batch_zmws, n_examples=len(all_windows),
+                   n_subreads=n_subreads))
+          return
+        predictions.sort(key=lambda p: (p.molecule_name, p.window_pos))
+        for name, group in itertools.groupby(
+            predictions, key=lambda p: p.molecule_name
+        ):
+          group = list(group)
+          try:
+            fastq = stitch.stitch_to_fastq(
+                molecule_name=name,
+                predictions=group,
+                max_length=options.max_length,
+                min_quality=options.min_quality,
+                min_length=options.min_length,
+                outcome_counter=outcome,
+            )
+            if fastq is not None:
+              emit(fastq, group)
+              fastq_lines += 1
+          except Exception as e:
+            if quarantine is None:
+              raise
+            # No draft CCS survives to this stage; stitch faults can
+            # only skip the molecule.
+            quarantine.handle(name, 'stitch', e, fallback=None)
+        for fb in feat.get('fallbacks', ()):
+          emit_fallback(fb)
+        t3 = time.time()
+        timing_rows.extend([
+            dict(stage='preprocess', runtime=feat['preprocess_time'],
+                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
+                 n_subreads=n_subreads),
+            dict(stage='run_model', runtime=t2 - t1, n_zmws=n_batch_zmws,
+                 n_examples=len(all_windows), n_subreads=n_subreads),
+            dict(stage='stitch_and_write_fastq', runtime=t3 - t2,
+                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
+                 n_subreads=n_subreads),
+        ])
+
+      # Cross-batch pipelining: a producer thread reads BAMs and
+      # featurizes batch N+1 while the main thread runs batch N through
+      # the model and stitcher. Counter discipline: the producer owns
+      # the feeder's `counter`; the main thread accumulates into
+      # `window_counter` and the two merge in the sidecar epilogue.
+      import queue as queue_lib
+      import threading
+
+      feat_queue: 'queue_lib.Queue' = queue_lib.Queue(maxsize=2)
+      stop = threading.Event()
+      skip_featurize = options.end_after_stage == 'dc_input'
+
+      def put(item) -> bool:
+        """Bounded put that aborts when the consumer has bailed."""
+        while not stop.is_set():
+          try:
+            feat_queue.put(item, timeout=0.5)
+            return True
+          except queue_lib.Full:
+            continue
+        return False
+
+      def producer():
+        try:
+          def flush(zmw_batch) -> bool:
+            if not zmw_batch:
+              return True
+            if skip_featurize:
+              # dc_input stage: measure BAM decode/grouping only, so the
+              # runtime CSV still carries one row per batch.
+              timing_rows.append(
+                  dict(stage='dc_input',
+                       runtime=time.time() - flush.t_start,
+                       n_zmws=len(zmw_batch), n_examples=0,
+                       n_subreads=sum(
+                           len(z[0]) - 1 for z in zmw_batch
+                           if not isinstance(z, faults.CcsFallback))))
+              flush.t_start = time.time()
+              return True
+            feat = featurize_batch(
+                zmw_batch, f'{shm_run_prefix}b{flush.seq}_')
+            flush.seq += 1
+            # Resume bookkeeping: how far the feeder had advanced when
+            # this batch was cut (includes skipped/sharded-out groups,
+            # which the resume replay skips the same way).
+            feat['groups_end'] = counter['n_zmw_processed']
+            last = zmw_batch[-1]
+            feat['last_zmw'] = (
+                last.molecule_name
+                if isinstance(last, faults.CcsFallback) else last[1]
+            )
+            ok = put(('batch', feat))
+            if not ok:
+              # Consumer bailed mid-flight: this batch will never be
+              # consumed, and its shm segments have no other owner.
+              release_shm(feat)
+            return ok
+
+          flush.t_start = time.time()
+          flush.seq = 0
+          zmw_batch = []
+          for zmw_input in feeder():
+            zmw_batch.append(zmw_input)
+            if options.batch_zmws and len(zmw_batch) >= options.batch_zmws:
+              if not flush(zmw_batch):
+                return
+              zmw_batch = []
+          if not flush(zmw_batch):
+            return
+          put(('done', None))
+        except BaseException as e:  # surface worker failures to the main thread
+          put(('error', e))
+
+      thread = threading.Thread(target=producer, daemon=True)
+      thread.start()
+      crash_after = faults.injected_crash_after_batches()
+      batches_consumed = 0
+      try:
+        while True:
+          kind, payload = feat_queue.get()
+          if kind == 'done':
+            break
+          if kind == 'error':
+            raise payload
+          consume_batch(payload)
+          batches_consumed += 1
+          if crash_after and batches_consumed >= crash_after:
+            raise RuntimeError(
+                f'injected crash after {batches_consumed} batch(es) '
+                f'({faults.ENV_CRASH_AFTER_BATCHES})'
+            )
+      finally:
+        stop.set()
+        thread.join(timeout=30)
+        if thread.is_alive():
+          # Draining now would race the producer's put(); anything it
+          # enqueues after our drain would leak its shm segments.
+          log.warning(
+              'producer thread still alive after 30s join; skipping '
+              'queue drain (shm segments may leak until exit)')
+        else:
+          # Producer confirmed dead: drain queued batches (error paths)
+          # without racing a concurrent put().
+          while True:
+            try:
+              kind, payload = feat_queue.get_nowait()
+            except queue_lib.Empty:
+              break
+            if kind == 'batch':
+              release_shm(payload)
     finally:
-      stop.set()
-      thread.join(timeout=30)
-      # Release any featurized batches still queued (error paths).
-      while not feat_queue.empty():
-        kind, payload = feat_queue.get_nowait()
-        if kind == 'batch':
-          release_shm(payload)
-    counter.update(window_counter)
+      close_out()
+      if watchdog is not None:
+        watchdog.close()
+    # Success: promote <output>.tmp to its final name atomically and
+    # drop the progress manifest.
+    os.replace(out_tmp, output)
+    manifest.delete()
+    partial = False
   finally:
-    close_out()
-    if pool is not None:
-      pool.close()
-      pool.join()
-
-  # Sidecar outputs (reference: quick_inference.py:777-791,961-962).
-  with open(output + '.runtime.csv', 'w', newline='') as f:
-    writer = csv.DictWriter(
-        f, fieldnames=['stage', 'runtime', 'n_zmws', 'n_examples',
-                       'n_subreads']
-    )
-    writer.writeheader()
-    writer.writerows(timing_rows)
-  counters = dict(counter)
-  counters.update(dataclasses.asdict(outcome))
-  with open(output + '.inference.json', 'w') as f:
-    json.dump(counters, f, indent=2, sort_keys=True)
+    if dead_letter is not None:
+      dead_letter.close()
+    counter.update(window_counter)
+    if quarantine is not None:
+      counter.update(quarantine.counters)
+    # Sidecar outputs (reference: quick_inference.py:777-791,961-962),
+    # written on failure too but stamped "partial": true so downstream
+    # tooling can't mistake a crashed run for a complete one.
+    counters = dict(counter)
+    counters.update(dataclasses.asdict(outcome))
+    if partial:
+      counters['partial'] = True
+    try:
+      with open(output + '.runtime.csv', 'w', newline='') as f:
+        csv_writer = csv.DictWriter(
+            f, fieldnames=['stage', 'runtime', 'n_zmws', 'n_examples',
+                           'n_subreads']
+        )
+        csv_writer.writeheader()
+        csv_writer.writerows(timing_rows)
+      with open(output + '.inference.json', 'w') as f:
+        json.dump(counters, f, indent=2, sort_keys=True)
+    except Exception:  # never mask the run's own error with sidecar IO
+      log.exception('failed to write sidecar outputs for %s', output)
   if not outcome.success and options.end_after_stage == 'full':
     log.warning('No reads passed filters; outcome=%s', outcome)
   return counters

@@ -57,6 +57,15 @@ _QUERY_OPS = np.array([1, 1, 0, 0, 1, 0, 0, 1, 1, 0], dtype=bool)
 _REF_OPS = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1, 0], dtype=bool)
 
 
+class TruncatedBamError(IOError):
+  """The BAM stream ended mid-record (or mid-BGZF-block).
+
+  Raised as a distinct type so the inference quarantine layer
+  (inference/faults.py) can classify it as a decode-stage fault: a
+  truncated stream cannot be advanced past, unlike a single malformed
+  record."""
+
+
 @dataclass
 class BamRecord:
   """One BAM alignment record."""
@@ -280,15 +289,22 @@ class BamReader:
     read = self._f.read
     refs = self.references
     while True:
-      size_bytes = read(4)
-      if not size_bytes:
-        return
-      if len(size_bytes) != 4:
-        raise IOError('truncated BAM record header')
-      (block_size,) = struct.unpack('<i', size_bytes)
-      data = read(block_size)
-      if len(data) != block_size:
-        raise IOError('truncated BAM record')
+      try:
+        size_bytes = read(4)
+        if not size_bytes:
+          return
+        if len(size_bytes) != 4:
+          raise TruncatedBamError(
+              f'{self.path}: truncated BAM record header')
+        (block_size,) = struct.unpack('<i', size_bytes)
+        data = read(block_size)
+        if len(data) != block_size:
+          raise TruncatedBamError(f'{self.path}: truncated BAM record')
+      except (EOFError, gzip.BadGzipFile) as e:
+        # gzip raises when a BGZF member is cut mid-block; normalize to
+        # the taxonomy's decode-stage truncation type.
+        raise TruncatedBamError(
+            f'{self.path}: BGZF stream truncated ({e})') from e
       yield parse_record(data, refs)
 
   def close(self) -> None:

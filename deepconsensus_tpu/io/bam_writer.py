@@ -21,12 +21,17 @@ _NIBBLE = {c: i for i, c in enumerate('=ACMGRSVTWYHKDBN')}
 
 
 class BgzfWriter:
-  """Writes BGZF-framed gzip blocks (max 64 KiB payload each)."""
+  """Writes BGZF-framed gzip blocks (max 64 KiB payload each).
+
+  append=True continues an existing file (resume support): the caller
+  must have truncated it to a block boundary (the progress manifest
+  records flushed sizes, which flush() guarantees are boundaries).
+  """
 
   MAX_BLOCK = 0xFF00
 
-  def __init__(self, path: str):
-    self._f = open(path, 'wb')
+  def __init__(self, path: str, append: bool = False):
+    self._f = open(path, 'ab' if append else 'wb')
     self._buf = bytearray()
 
   def write(self, data: bytes) -> None:
@@ -34,6 +39,20 @@ class BgzfWriter:
     while len(self._buf) >= self.MAX_BLOCK:
       self._flush_block(self._buf[: self.MAX_BLOCK])
       del self._buf[: self.MAX_BLOCK]
+
+  def flush(self) -> None:
+    """Flushes buffered payload as a (possibly short) block to the OS.
+    BGZF permits arbitrary block boundaries, so the file is a valid
+    prefix afterwards — the durability point for the progress
+    manifest."""
+    if self._buf:
+      self._flush_block(bytes(self._buf))
+      self._buf.clear()
+    self._f.flush()
+
+  def tell(self) -> int:
+    """Byte size of the durable file prefix (call flush() first)."""
+    return self._f.tell()
 
   def _flush_block(self, payload: bytes) -> None:
     compressor = zlib.compressobj(6, zlib.DEFLATED, -15)
@@ -89,8 +108,14 @@ def encode_record(
     quals: Optional[np.ndarray],
     flag: int = 4,
     tags: Optional[Dict[str, Any]] = None,
+    ref_id: int = -1,
+    pos: int = -1,
+    mapq: Optional[int] = None,
+    cigar: Optional[List[Tuple[int, int]]] = None,
 ) -> bytes:
-  """Encodes one (by default unmapped) BAM record."""
+  """Encodes one BAM record (unmapped by default; pass ref_id/pos/cigar
+  for mapped records, e.g. the fault-injection harness's synthetic
+  subreads-to-CCS alignments)."""
   name_b = qname.encode('ascii') + b'\x00'
   l_seq = len(seq)
   packed = bytearray((l_seq + 1) // 2)
@@ -107,15 +132,21 @@ def encode_record(
   tag_b = b''
   for tag_name, value in (tags or {}).items():
     tag_b += _encode_tag(tag_name, value)
+  cigar = cigar or []
+  cigar_b = b''.join(
+      struct.pack('<I', (int(ln) << 4) | int(op)) for op, ln in cigar
+  )
+  if mapq is None:
+    mapq = 255 if flag & 4 else 0
   body = (
       struct.pack(
           '<iiBBHHHiiii',
-          -1,  # ref_id
-          -1,  # pos
+          ref_id,
+          pos,
           len(name_b),
-          255 if flag & 4 else 0,  # mapq: 255 = unavailable
-          4680,  # bin for unmapped (reg2bin(-1,0))
-          0,  # n_cigar
+          mapq,
+          4680,  # bin (unused by our reader)
+          len(cigar),
           flag,
           l_seq,
           -1,
@@ -123,6 +154,7 @@ def encode_record(
           0,
       )
       + name_b
+      + cigar_b
       + bytes(packed)
       + qual_b
       + tag_b
@@ -131,11 +163,19 @@ def encode_record(
 
 
 class BamWriter:
-  """Writes an (unaligned) BAM with the given header text."""
+  """Writes a BAM with the given header text (unaligned by default).
+
+  append=True continues an existing (header-bearing) file without
+  re-emitting the header — the resume path for atomic <output>.tmp
+  BAMs after the caller truncated to the manifest's committed size.
+  """
 
   def __init__(self, path: str, header_text: str = '',
-               references: Optional[List[Tuple[str, int]]] = None):
-    self._bgzf = BgzfWriter(path)
+               references: Optional[List[Tuple[str, int]]] = None,
+               append: bool = False):
+    self._bgzf = BgzfWriter(path, append=append)
+    if append:
+      return
     references = references or []
     head = b'BAM\x01'
     text = header_text.encode('ascii')
@@ -148,8 +188,19 @@ class BamWriter:
     self._bgzf.write(head)
 
   def write(self, qname: str, seq: str, quals: Optional[np.ndarray],
-            tags: Optional[Dict[str, Any]] = None, flag: int = 4) -> None:
-    self._bgzf.write(encode_record(qname, seq, quals, flag=flag, tags=tags))
+            tags: Optional[Dict[str, Any]] = None, flag: int = 4,
+            ref_id: int = -1, pos: int = -1,
+            cigar: Optional[List[Tuple[int, int]]] = None) -> None:
+    self._bgzf.write(
+        encode_record(qname, seq, quals, flag=flag, tags=tags,
+                      ref_id=ref_id, pos=pos, cigar=cigar)
+    )
+
+  def flush(self) -> None:
+    self._bgzf.flush()
+
+  def tell(self) -> int:
+    return self._bgzf.tell()
 
   def close(self) -> None:
     self._bgzf.close()

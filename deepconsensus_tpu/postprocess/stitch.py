@@ -87,6 +87,39 @@ def format_as_fastq(name: str, sequence: str, quality_string: str) -> str:
   return f'@{name}\n{sequence}\n+\n{quality_string}\n'
 
 
+def fallback_to_fastq(
+    molecule_name: str,
+    sequence: str,
+    quality_scores,
+    min_quality: int,
+    min_length: int,
+    max_base_quality: int,
+    counter,
+) -> Optional[str]:
+  """Formats a quarantined ZMW's draft CCS read (--on-zmw-error=
+  ccs-fallback) with its original base qualities, applying the same
+  min_quality/min_length gates as stitched reads. Counted under
+  n_fallback_* keys — deliberately not OutcomeCounter, so `success`
+  keeps meaning "model-polished reads" and fallback yield stays
+  separately accountable."""
+  if not sequence:
+    counter['n_fallback_empty'] += 1
+    return None
+  quals = np.clip(
+      np.asarray(quality_scores, dtype=np.int64), 0, max_base_quality
+  )
+  if round(phred.avg_phred(quals), 5) < min_quality:
+    counter['n_fallback_failed_quality_filter'] += 1
+    return None
+  if len(sequence) < min_length:
+    counter['n_fallback_failed_length_filter'] += 1
+    return None
+  counter['n_fallback_emitted'] += 1
+  return format_as_fastq(
+      molecule_name, sequence, phred.quality_scores_to_string(quals)
+  )
+
+
 def stitch_to_fastq(
     molecule_name: str,
     predictions: Iterable[DCModelOutput],

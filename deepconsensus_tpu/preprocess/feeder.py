@@ -123,12 +123,24 @@ def create_proc_feeder(
     limit: int = 0,
     ccs_fasta: Optional[str] = None,
     shard: Optional[Tuple[int, int]] = None,
+    quarantine=None,
+    resume_skip_groups: int = 0,
 ):
   """Returns (generator_fn, counter) yielding per-ZMW work items.
 
   shard=(i, n) keeps only ZMWs with zm % n == i — built-in fleet
   scaling over one shared BAM, replacing the reference's external
   500-way BAM-splitting step (docs/quick_start.md:82-99 upstream).
+
+  quarantine (inference.faults.Quarantine, optional) applies the
+  --on-zmw-error policy: per-ZMW decode/expansion failures are
+  dead-lettered and either skipped or replaced by a CcsFallback item
+  (yielded in-stream; callers must dispatch on type). Without it the
+  feeder keeps its historical fail-fast behavior.
+
+  resume_skip_groups fast-skips the first N subread groups (no
+  expansion work; the lockstep ccs_iter scan self-heals) — the
+  --resume path replaying the feeder past already-committed ZMWs.
   """
   main_counter: Counter = Counter()
   grouper = bam.SubreadGrouper(subreads_to_ccs)
@@ -146,9 +158,32 @@ def create_proc_feeder(
     truth_split_dict = read_truth_split(truth_split)
 
   def proc_feeder() -> Iterator[ZmwInput]:
-    for read_set in grouper:
+    groups = iter(grouper)
+    last_name: Optional[str] = None
+    while True:
+      try:
+        read_set = next(groups)
+      except StopIteration:
+        break
+      except Exception as e:
+        # Stream-level decode failure (truncated/corrupt BGZF or BAM
+        # framing): the stream cannot be advanced past it, so record
+        # one decode fault and end the feed. Everything already
+        # yielded stays valid.
+        main_counter['n_zmw_decode_failed'] += 1
+        if quarantine is None:
+          raise
+        quarantine.handle(
+            f'<stream after {last_name}>' if last_name else '<stream>',
+            'decode', e, fallback=None,
+        )
+        break
       main_counter['n_zmw_processed'] += 1
+      if main_counter['n_zmw_processed'] <= resume_skip_groups:
+        main_counter['n_zmw_resume_skipped'] += 1
+        continue
       ccs_seqname = read_set[0].reference_name
+      last_name = ccs_seqname
       if shard is not None:
         # The lockstep ccs_iter scan below skips over filtered ZMWs'
         # records on its own (both BAMs share the same order), so a
@@ -163,23 +198,44 @@ def create_proc_feeder(
         if zm % shard[1] != shard[0]:
           main_counter['n_zmw_sharded_out'] += 1
           continue
-      subreads = [
-          expand_aligned_record(rec, ins_trim=ins_trim, counter=main_counter)
-          for rec in read_set
-      ]
-      # The ccs bam is ordered like the subread bam; skip CCS reads with
-      # no mapped subreads (reference: pre_lib.py:1320-1326).
-      for ccs_record in ccs_iter:
-        if ccs_record.qname == ccs_seqname:
-          break
-      else:
-        raise ValueError(f'ccs bam does not contain {ccs_seqname}')
+      # Scan for the draft CCS before expanding subreads so a
+      # per-ZMW expansion failure still has the draft available for
+      # the ccs-fallback policy. The ccs bam is ordered like the
+      # subread bam; skip CCS reads with no mapped subreads
+      # (reference: pre_lib.py:1320-1326).
+      ccs_record = None
+      try:
+        for candidate in ccs_iter:
+          if candidate.qname == ccs_seqname:
+            ccs_record = candidate
+            break
+        else:
+          raise ValueError(f'ccs bam does not contain {ccs_seqname}')
+        subreads = [
+            expand_aligned_record(
+                rec, ins_trim=ins_trim, counter=main_counter)
+            for rec in read_set
+        ]
+        ccs_read = construct_ccs_read(ccs_record)
+        window_widths = None
+        if use_ccs_smart_windows:
+          window_widths = np.asarray(ccs_record.get_tag('wl'))
+        subreads.append(ccs_read)
+      except Exception as e:
+        if quarantine is None:
+          raise
+        record = ccs_record
+        fallback = None
+        if record is not None:
+          def fallback(rec=record):
+            from deepconsensus_tpu.inference import faults
 
-      ccs_read = construct_ccs_read(ccs_record)
-      window_widths = None
-      if use_ccs_smart_windows:
-        window_widths = np.asarray(ccs_record.get_tag('wl'))
-      subreads.append(ccs_read)
+            return faults.fallback_from_record(rec)
+        item = quarantine.handle(ccs_seqname, 'featurize', e,
+                                 fallback=fallback)
+        if item is not None:
+          yield item
+        continue
 
       if is_training:
         truth_range = truth_ref_coords.get(ccs_seqname)

@@ -1,0 +1,222 @@
+#!/usr/bin/env python3
+"""Fault-injection harness for the inference fault-tolerance layer.
+
+Three tools, usable from the CLI or imported by tests:
+
+* synth    — write a synthetic (subreads_to_ccs.bam, ccs.bam) pair with
+             deterministic sequences, one BGZF block per ZMW so a
+             truncation lands mid-file rather than killing block 0.
+* corrupt  — re-encode a subreads BAM dropping aux tags (default: pw)
+             from one target ZMW, which makes expand_aligned_record
+             raise for exactly that molecule (a featurize-stage fault).
+* truncate — chop a file to a fraction/byte count, producing a
+             mid-stream BGZF decode fault (decode-stage).
+
+Worker SIGKILL and consumer-crash injection are driven by env vars read
+by deepconsensus_tpu/inference/faults.py (ENV_KILL_ZMW, ENV_KILL_TOKEN,
+ENV_CRASH_AFTER_BATCHES); this script documents them in --help.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepconsensus_tpu.io import bam as bam_lib  # noqa: E402
+from deepconsensus_tpu.io.bam_writer import BamWriter  # noqa: E402
+
+_BASES = np.frombuffer(b'ACGT', dtype=np.uint8)
+
+
+def write_synthetic_zmw_bams(
+    out_dir: str,
+    n_zmws: int = 6,
+    n_subreads: int = 3,
+    seq_len: int = 120,
+    movie: str = 'm00001_000000_000000',
+    seed: int = 7,
+    base_qual: int = 30,
+    plain_names: bool = False,
+) -> Tuple[str, str]:
+  """Writes (subreads_to_ccs.bam, ccs.bam) for n_zmws molecules.
+
+  Subreads are exact copies of the draft CCS (all-match cigar) with
+  deterministic pw/ip/sn tags, grouped per ZMW and flushed into their
+  own BGZF block so truncate() faults mid-file. The ccs BAM carries
+  quals=base_qual and ec/np/rq/RG tags. plain_names drops the PacBio
+  movie/zmw/ccs structure (exercises the defensive zm-tag parse).
+  """
+  rng = np.random.RandomState(seed)
+  os.makedirs(out_dir, exist_ok=True)
+  subreads_path = os.path.join(out_dir, 'subreads_to_ccs.bam')
+  ccs_path = os.path.join(out_dir, 'ccs.bam')
+
+  zmw_ids = [100 + i for i in range(n_zmws)]
+  if plain_names:
+    ccs_names = [f'read{z}' for z in zmw_ids]
+  else:
+    ccs_names = [f'{movie}/{z}/ccs' for z in zmw_ids]
+  seqs = [
+      bytes(_BASES[rng.randint(0, 4, seq_len)]).decode('ascii')
+      for _ in zmw_ids
+  ]
+
+  sub_writer = BamWriter(
+      subreads_path,
+      header_text='@HD\tVN:1.5\tSO:unknown\n',
+      references=[(name, seq_len) for name in ccs_names],
+  )
+  for i, (zmw, seq) in enumerate(zip(zmw_ids, seqs)):
+    for k in range(n_subreads):
+      if plain_names:
+        qname = f'sub{zmw}_{k}'
+      else:
+        qname = f'{movie}/{zmw}/{k * 1000}_{k * 1000 + seq_len}'
+      tags = {
+          'zm': zmw,
+          'pw': rng.randint(1, 6, seq_len).astype(np.int32),
+          'ip': rng.randint(1, 9, seq_len).astype(np.int32),
+          'sn': rng.uniform(4.0, 12.0, 4).astype(np.float32),
+      }
+      sub_writer.write(
+          qname, seq, None, tags=tags, flag=0, ref_id=i, pos=0,
+          cigar=[(0, seq_len)],
+      )
+    # One BGZF block per ZMW: a later truncate() then faults mid-file
+    # instead of corrupting the first group.
+    sub_writer.flush()
+  sub_writer.close()
+
+  ccs_writer = BamWriter(
+      ccs_path,
+      header_text='@HD\tVN:1.5\tSO:unknown\n'
+      '@RG\tID:rg1\tPL:PACBIO\tSM:synthetic\n',
+  )
+  for name, seq in zip(ccs_names, seqs):
+    ccs_writer.write(
+        name, seq, np.full(seq_len, base_qual, dtype=np.uint8),
+        tags={
+            'ec': float(n_subreads),
+            'np': int(n_subreads),
+            'rq': 0.99,
+            'RG': 'rg1',
+        },
+        flag=4,
+    )
+    ccs_writer.flush()
+  ccs_writer.close()
+  return subreads_path, ccs_path
+
+
+def corrupt_zmw(
+    in_bam: str,
+    out_bam: str,
+    zmw: int,
+    drop_tags: Sequence[str] = ('pw',),
+) -> int:
+  """Re-encodes in_bam with drop_tags removed from records of one ZMW.
+
+  Dropping 'pw' makes expand_aligned_record raise KeyError('pw') for
+  exactly that molecule — the canonical per-ZMW featurize fault.
+  Returns the number of corrupted records.
+  """
+  reader = bam_lib.BamReader(in_bam)
+  # Our reader ignores declared reference lengths; 0 keeps the header
+  # faithful enough for round-tripping.
+  writer = BamWriter(
+      out_bam,
+      header_text=reader.header_text,
+      references=[(name, 0) for name in reader.references],
+  )
+  n_corrupted = 0
+  for rec in reader:
+    tags = dict(rec.tags)
+    if int(tags.get('zm', -1)) == zmw:
+      for tag in drop_tags:
+        tags.pop(tag, None)
+      n_corrupted += 1
+    writer.write(
+        rec.qname, rec.seq, rec.quals, tags=tags, flag=rec.flag,
+        ref_id=rec.ref_id, pos=rec.pos,
+        cigar=list(zip(rec.cigar_ops.tolist(), rec.cigar_lens.tolist())),
+    )
+  writer.close()
+  return n_corrupted
+
+
+def truncate_file(path: str, fraction: float = 0.5,
+                  keep_bytes: Optional[int] = None) -> int:
+  """Truncates path mid-stream; returns the new size."""
+  size = os.path.getsize(path)
+  keep = keep_bytes if keep_bytes is not None else max(1, int(size * fraction))
+  with open(path, 'r+b') as f:
+    f.truncate(keep)
+  return keep
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  parser = argparse.ArgumentParser(
+      description=__doc__,
+      formatter_class=argparse.RawDescriptionHelpFormatter,
+      epilog=(
+          'Env-var hooks (read by inference/faults.py):\n'
+          '  DCTPU_FAULT_KILL_ZMW=<ccs name>   SIGKILL the pool worker '
+          'featurizing that ZMW\n'
+          '  DCTPU_FAULT_KILL_TOKEN=<path>     kill only once (token '
+          'file created on first kill)\n'
+          '  DCTPU_FAULT_CRASH_AFTER_BATCHES=N crash the consumer loop '
+          'after N batches\n'
+      ),
+  )
+  sub = parser.add_subparsers(dest='command', required=True)
+
+  p = sub.add_parser('synth', help='Write synthetic subreads/ccs BAMs.')
+  p.add_argument('--out_dir', required=True)
+  p.add_argument('--n_zmws', type=int, default=6)
+  p.add_argument('--n_subreads', type=int, default=3)
+  p.add_argument('--seq_len', type=int, default=120)
+  p.add_argument('--seed', type=int, default=7)
+  p.add_argument('--base_qual', type=int, default=30)
+  p.add_argument('--plain_names', action='store_true')
+
+  p = sub.add_parser('corrupt', help='Drop aux tags from one ZMW.')
+  p.add_argument('--in_bam', required=True)
+  p.add_argument('--out_bam', required=True)
+  p.add_argument('--zmw', type=int, required=True)
+  p.add_argument('--drop_tag', action='append', default=None,
+                 help='Tag to drop (repeatable; default pw).')
+
+  p = sub.add_parser('truncate', help='Truncate a file mid-stream.')
+  p.add_argument('--path', required=True)
+  p.add_argument('--fraction', type=float, default=0.5)
+  p.add_argument('--bytes', type=int, default=None, dest='keep_bytes')
+
+  args = parser.parse_args(argv)
+  if args.command == 'synth':
+    subreads, ccs = write_synthetic_zmw_bams(
+        args.out_dir, n_zmws=args.n_zmws, n_subreads=args.n_subreads,
+        seq_len=args.seq_len, seed=args.seed, base_qual=args.base_qual,
+        plain_names=args.plain_names,
+    )
+    print(subreads)
+    print(ccs)
+    return 0
+  if args.command == 'corrupt':
+    n = corrupt_zmw(args.in_bam, args.out_bam, args.zmw,
+                    drop_tags=tuple(args.drop_tag or ('pw',)))
+    print(f'corrupted {n} record(s)')
+    return 0 if n else 1
+  if args.command == 'truncate':
+    print(truncate_file(args.path, fraction=args.fraction,
+                        keep_bytes=args.keep_bytes))
+    return 0
+  return 2
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Runs the fault-injection (resilience) test suite on CPU.
+#
+# These tests exercise the inference fault-tolerance layer — per-ZMW
+# quarantine, CCS fallback, the pool watchdog (real SIGKILLs), and
+# crash/resume — against synthetic BAMs, so they need no reference
+# testdata and no accelerator. The timeout keeps the suite inside the
+# tier-1 budget; the whole run takes well under a minute on a laptop.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q -m resilience \
+  --continue-on-collection-errors "$@"

@@ -30,6 +30,14 @@ import pytest
 REFERENCE_TESTDATA = pathlib.Path('/root/reference/deepconsensus/testdata')
 
 
+def pytest_configure(config):
+  config.addinivalue_line(
+      'markers',
+      'resilience: fault-injection tests for the inference '
+      'fault-tolerance layer (scripts/run_resilience.sh)',
+  )
+
+
 @pytest.fixture(scope='session')
 def testdata_dir() -> pathlib.Path:
   if not REFERENCE_TESTDATA.exists():
@@ -47,3 +55,16 @@ def scripts_importable():
   if repo_root not in sys.path:
     sys.path.insert(0, repo_root)
   return repo_root
+
+
+@pytest.fixture
+def synthetic_bams(tmp_path, scripts_importable):
+  """Factory for synthetic (subreads_to_ccs.bam, ccs.bam) pairs built
+  by the fault-injection harness — no reference testdata needed."""
+  from scripts import inject_faults
+
+  def make(subdir: str = 'bams', **kwargs):
+    return inject_faults.write_synthetic_zmw_bams(
+        str(tmp_path / subdir), **kwargs)
+
+  return make

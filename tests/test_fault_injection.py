@@ -1,0 +1,378 @@
+"""Fault-injection tests for the inference fault-tolerance layer.
+
+Every test runs against synthetic BAMs written by scripts/inject_faults
+(no reference testdata), with skip_windows_above=1 so all windows adopt
+the draft CCS and no jitted forward pass compiles — the faults under
+test live in the feeder/pool/writer layers, not the model.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.inference import faults
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.preprocess.feeder import create_proc_feeder
+from deepconsensus_tpu.preprocess.pileup import FeatureLayout
+
+pytestmark = pytest.mark.resilience
+
+MOVIE = 'm00001_000000_000000'
+CORRUPT_ZMW = 102
+CORRUPT_NAME = f'{MOVIE}/{CORRUPT_ZMW}/ccs'
+
+
+@pytest.fixture(scope='module')
+def params():
+  p = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(p, is_training=False)
+  return p
+
+
+def _make_runner(params, **kwargs):
+  kwargs.setdefault('batch_size', 32)
+  kwargs.setdefault('batch_zmws', 2)
+  kwargs.setdefault('skip_windows_above', 1)  # all windows adopt CCS
+  kwargs.setdefault('min_quality', 0)
+  options = runner_lib.InferenceOptions(**kwargs)
+  # Empty variables: the forward pass is never invoked on the
+  # skip-everything path, so no weights (and no jit compile) needed.
+  return runner_lib.ModelRunner(params, {}, options), options
+
+
+def _fastq_names(path):
+  with open(path) as f:
+    return [line.rstrip('\n')[1:] for line in f if line.startswith('@')]
+
+
+def _corrupt(inject_faults_mod, subreads, tmp_path, zmw=CORRUPT_ZMW):
+  bad = str(tmp_path / 'corrupt.bam')
+  n = inject_faults_mod.corrupt_zmw(subreads, bad, zmw)
+  assert n > 0
+  return bad
+
+
+@pytest.fixture
+def inject(scripts_importable):
+  from scripts import inject_faults
+  return inject_faults
+
+
+class TestFeederFaults:
+  """Satellite: truncated/corrupt subreads BAM through create_proc_feeder."""
+
+  def _layout(self):
+    return FeatureLayout(max_passes=20, max_length=100, use_ccs_bq=False)
+
+  def test_corrupt_zmw_fail_fast_raises(self, synthetic_bams, inject,
+                                        tmp_path):
+    subreads, ccs = synthetic_bams()
+    bad = _corrupt(inject, subreads, tmp_path)
+    feeder, _ = create_proc_feeder(bad, ccs_bam=ccs, layout=self._layout())
+    with pytest.raises(KeyError):
+      list(feeder())
+
+  def test_corrupt_zmw_skip_policy(self, synthetic_bams, inject, tmp_path):
+    subreads, ccs = synthetic_bams()
+    bad = _corrupt(inject, subreads, tmp_path)
+    quarantine = faults.Quarantine('skip', None)
+    feeder, _ = create_proc_feeder(
+        bad, ccs_bam=ccs, layout=self._layout(), quarantine=quarantine)
+    names = [item[1] for item in feeder()]
+    assert CORRUPT_NAME not in names
+    assert len(names) == 5
+    assert quarantine.counters['n_zmw_skipped_on_error'] == 1
+    assert quarantine.counters['n_fault_featurize'] == 1
+
+  def test_corrupt_zmw_ccs_fallback_yields_draft(self, synthetic_bams,
+                                                 inject, tmp_path):
+    subreads, ccs = synthetic_bams()
+    bad = _corrupt(inject, subreads, tmp_path)
+    quarantine = faults.Quarantine('ccs-fallback', None)
+    feeder, _ = create_proc_feeder(
+        bad, ccs_bam=ccs, layout=self._layout(), quarantine=quarantine)
+    items = list(feeder())
+    fallbacks = [i for i in items if isinstance(i, faults.CcsFallback)]
+    assert len(fallbacks) == 1
+    fb = fallbacks[0]
+    assert fb.molecule_name == CORRUPT_NAME
+    # Draft CCS carries the original bases and qualities.
+    ccs_rec = next(r for r in bam_lib.BamReader(ccs)
+                   if r.qname == CORRUPT_NAME)
+    assert fb.sequence == ccs_rec.seq
+    np.testing.assert_array_equal(fb.quality_scores, ccs_rec.quals)
+    assert quarantine.counters['n_zmw_ccs_fallback'] == 1
+
+  def test_truncated_bam_mid_file_decode_fault(self, synthetic_bams,
+                                               inject, tmp_path):
+    import shutil
+
+    subreads, ccs = synthetic_bams()
+    trunc = str(tmp_path / 'trunc.bam')
+    shutil.copy(subreads, trunc)
+    inject.truncate_file(trunc, fraction=0.5)
+    # Fail-fast: the decode error propagates.
+    feeder, _ = create_proc_feeder(trunc, ccs_bam=ccs,
+                                   layout=self._layout())
+    with pytest.raises(bam_lib.TruncatedBamError):
+      list(feeder())
+    # Quarantined: the groups before the truncation point still come
+    # through, then one decode dead-letter ends the stream.
+    quarantine = faults.Quarantine('skip', None)
+    feeder2, counter = create_proc_feeder(
+        trunc, ccs_bam=ccs, layout=self._layout(), quarantine=quarantine)
+    items = list(feeder2())
+    assert 0 < len(items) < 6
+    assert counter['n_zmw_decode_failed'] == 1
+    assert quarantine.counters['n_fault_decode'] == 1
+
+
+class TestEndToEndQuarantine:
+  """Acceptance (a): corrupted ZMW + ccs-fallback completes the run,
+  emits the draft CCS, and records one dead-letter entry."""
+
+  @pytest.mark.parametrize('cpus', [1, 2])
+  def test_corrupt_zmw_ccs_fallback_run(self, synthetic_bams, inject,
+                                        tmp_path, params, cpus):
+    subreads, ccs = synthetic_bams()
+    bad = _corrupt(inject, subreads, tmp_path)
+    out = str(tmp_path / 'out.fastq')
+    runner, options = _make_runner(
+        params, on_zmw_error='ccs-fallback', cpus=cpus,
+        batch_timeout=30.0 if cpus > 1 else 0.0)
+    counters = runner_lib.run_inference(bad, ccs, None, out,
+                                        options=options, runner=runner)
+    assert counters['success'] == 5
+    assert counters['n_zmw_ccs_fallback'] == 1
+    assert counters['n_fallback_emitted'] == 1
+    assert 'partial' not in counters
+    names = _fastq_names(out)
+    assert CORRUPT_NAME in names and len(names) == 6
+    letters = faults.read_dead_letters(out + '.failed.jsonl')
+    assert len(letters) == 1
+    assert letters[0]['zmw'] == CORRUPT_NAME
+    assert letters[0]['stage'] == 'featurize'
+    assert letters[0]['action'] == 'ccs-fallback'
+    # Atomic output: no tmp/manifest remnants after success.
+    assert not os.path.exists(out + '.tmp')
+    assert not os.path.exists(out + '.progress.json')
+
+  @pytest.mark.parametrize('cpus', [1, 2])
+  def test_corrupt_zmw_skip_run_counters(self, synthetic_bams, inject,
+                                         tmp_path, params, cpus):
+    subreads, ccs = synthetic_bams()
+    bad = _corrupt(inject, subreads, tmp_path)
+    out = str(tmp_path / 'out.fastq')
+    runner, options = _make_runner(
+        params, on_zmw_error='skip', cpus=cpus,
+        batch_timeout=30.0 if cpus > 1 else 0.0)
+    counters = runner_lib.run_inference(bad, ccs, None, out,
+                                        options=options, runner=runner)
+    assert counters['success'] == 5
+    assert counters['n_zmw_skipped_on_error'] == 1
+    assert counters.get('n_fallback_emitted', 0) == 0
+    assert CORRUPT_NAME not in _fastq_names(out)
+
+  def test_corrupt_zmw_fail_policy_aborts(self, synthetic_bams, inject,
+                                          tmp_path, params):
+    subreads, ccs = synthetic_bams()
+    bad = _corrupt(inject, subreads, tmp_path)
+    out = str(tmp_path / 'out.fastq')
+    runner, options = _make_runner(params)  # on_zmw_error='fail'
+    with pytest.raises(KeyError):
+      runner_lib.run_inference(bad, ccs, None, out,
+                               options=options, runner=runner)
+    # Crashed run leaves no plausible final output, but does leave a
+    # partial-stamped sidecar (satellite: no unconditional sidecars).
+    assert not os.path.exists(out)
+    sidecar = json.load(open(out + '.inference.json'))
+    assert sidecar.get('partial') is True
+
+
+class TestWatchdog:
+  """Acceptance (b): SIGKILLing a pool worker mid-batch triggers the
+  watchdog retry and output is byte-identical to an uninterrupted run."""
+
+  def test_sigkilled_worker_retries_byte_identical(
+      self, synthetic_bams, inject, tmp_path, params, monkeypatch):
+    subreads, ccs = synthetic_bams()
+    shm_before = set(glob.glob('/dev/shm/*'))
+
+    ref_out = str(tmp_path / 'ref.fastq')
+    runner, options = _make_runner(
+        params, cpus=2, batch_timeout=5.0, batch_retries=2,
+        on_zmw_error='ccs-fallback')
+    runner_lib.run_inference(subreads, ccs, None, ref_out,
+                             options=options, runner=runner)
+
+    kill_out = str(tmp_path / 'kill.fastq')
+    token = str(tmp_path / 'kill.token')
+    monkeypatch.setenv(faults.ENV_KILL_ZMW, CORRUPT_NAME)
+    monkeypatch.setenv(faults.ENV_KILL_TOKEN, token)
+    runner2, options2 = _make_runner(
+        params, cpus=2, batch_timeout=5.0, batch_retries=2,
+        on_zmw_error='ccs-fallback')
+    counters = runner_lib.run_inference(subreads, ccs, None, kill_out,
+                                        options=options2, runner=runner2)
+    assert os.path.exists(token), 'kill was never injected'
+    assert counters['n_watchdog_timeouts'] >= 1
+    assert counters['n_pool_respawns'] >= 1
+    assert counters['success'] == 6
+    # The retry recovered every ZMW: nothing quarantined, output
+    # byte-identical to the uninterrupted run.
+    assert counters.get('n_zmw_quarantined', 0) == 0
+    with open(ref_out, 'rb') as a, open(kill_out, 'rb') as b:
+      assert a.read() == b.read()
+    leaked = {
+        p for p in set(glob.glob('/dev/shm/*')) - shm_before
+        if 'dctpu' in p or 'psm' in p
+    }
+    assert not leaked, f'leaked shm segments: {leaked}'
+
+  def test_watchdog_exhaustion_quarantines_batch(
+      self, synthetic_bams, inject, tmp_path, params, monkeypatch):
+    subreads, ccs = synthetic_bams(n_zmws=2)
+    out = str(tmp_path / 'out.fastq')
+    # No kill token: every attempt re-kills the worker, exhausting the
+    # watchdog; ccs-fallback then recovers the whole batch.
+    monkeypatch.setenv(faults.ENV_KILL_ZMW, f'{MOVIE}/100/ccs')
+    runner, options = _make_runner(
+        params, cpus=2, batch_timeout=2.0, batch_retries=1,
+        on_zmw_error='ccs-fallback')
+    counters = runner_lib.run_inference(subreads, ccs, None, out,
+                                        options=options, runner=runner)
+    assert counters['n_watchdog_timeouts'] >= 2
+    assert counters['n_zmw_quarantined'] == 2
+    assert counters['n_fallback_emitted'] == 2
+    assert sorted(_fastq_names(out)) == [
+        f'{MOVIE}/100/ccs', f'{MOVIE}/101/ccs']
+
+
+class TestResume:
+  """Acceptance (c): interrupt + --resume yields the same ZMW set as an
+  uninterrupted run, no duplicates, no leaked shm segments."""
+
+  @pytest.mark.parametrize('suffix', ['fastq', 'bam'])
+  def test_crash_and_resume_same_zmw_set(self, synthetic_bams, inject,
+                                         tmp_path, params, monkeypatch,
+                                         suffix):
+    subreads, ccs = synthetic_bams(n_zmws=6)
+    shm_before = set(glob.glob('/dev/shm/*'))
+
+    ref_out = str(tmp_path / f'ref.{suffix}')
+    runner, options = _make_runner(params)
+    runner_lib.run_inference(subreads, ccs, None, ref_out,
+                             options=options, runner=runner)
+
+    out = str(tmp_path / f'out.{suffix}')
+    monkeypatch.setenv(faults.ENV_CRASH_AFTER_BATCHES, '1')
+    runner2, options2 = _make_runner(params)
+    with pytest.raises(RuntimeError, match='injected crash'):
+      runner_lib.run_inference(subreads, ccs, None, out,
+                               options=options2, runner=runner2)
+    monkeypatch.delenv(faults.ENV_CRASH_AFTER_BATCHES)
+    assert not os.path.exists(out)
+    assert os.path.exists(out + '.tmp')
+    manifest = json.load(open(out + '.progress.json'))
+    assert manifest['groups_done'] == 2
+    assert json.load(open(out + '.inference.json')).get('partial') is True
+
+    runner3, options3 = _make_runner(params, resume=True)
+    counters = runner_lib.run_inference(subreads, ccs, None, out,
+                                        options=options3, runner=runner3)
+    assert counters['n_zmw_resume_skipped'] == 2
+    assert 'partial' not in counters
+    assert not os.path.exists(out + '.progress.json')
+    assert not os.path.exists(out + '.tmp')
+
+    if suffix == 'bam':
+      ref_names = sorted(r.qname for r in bam_lib.BamReader(ref_out))
+      got_names = sorted(r.qname for r in bam_lib.BamReader(out))
+    else:
+      ref_names = sorted(_fastq_names(ref_out))
+      got_names = sorted(_fastq_names(out))
+    assert got_names == ref_names
+    assert len(got_names) == len(set(got_names)), 'duplicate ZMWs'
+    leaked = {
+        p for p in set(glob.glob('/dev/shm/*')) - shm_before
+        if 'dctpu' in p or 'psm' in p
+    }
+    assert not leaked, f'leaked shm segments: {leaked}'
+
+  def test_resume_rejects_different_source(self, synthetic_bams, inject,
+                                           tmp_path, params, monkeypatch):
+    subreads, ccs = synthetic_bams('a')
+    other_subreads, other_ccs = synthetic_bams('b', seed=9)
+    out = str(tmp_path / 'out.fastq')
+    monkeypatch.setenv(faults.ENV_CRASH_AFTER_BATCHES, '1')
+    runner, options = _make_runner(params)
+    with pytest.raises(RuntimeError):
+      runner_lib.run_inference(subreads, ccs, None, out,
+                               options=options, runner=runner)
+    monkeypatch.delenv(faults.ENV_CRASH_AFTER_BATCHES)
+    runner2, options2 = _make_runner(params, resume=True)
+    with pytest.raises(ValueError, match='manifest mismatch'):
+      runner_lib.run_inference(other_subreads, other_ccs, None, out,
+                               options=options2, runner=runner2)
+
+
+class TestSatellites:
+
+  def test_plain_names_bam_output_omits_zm_tag(self, synthetic_bams,
+                                               tmp_path, params):
+    """BAM emit must not crash on non-PacBio read names (satellite:
+    defensive zm parse)."""
+    subreads, ccs = synthetic_bams(plain_names=True)
+    out = str(tmp_path / 'out.bam')
+    runner, options = _make_runner(params)
+    counters = runner_lib.run_inference(subreads, ccs, None, out,
+                                        options=options, runner=runner)
+    assert counters['success'] == 6
+    records = list(bam_lib.BamReader(out))
+    assert len(records) == 6
+    for rec in records:
+      assert not rec.has_tag('zm')
+      assert rec.has_tag('rq')
+
+  def test_pacbio_names_bam_output_keeps_zm_tag(self, synthetic_bams,
+                                                tmp_path, params):
+    subreads, ccs = synthetic_bams()
+    out = str(tmp_path / 'out.bam')
+    runner, options = _make_runner(params)
+    runner_lib.run_inference(subreads, ccs, None, out,
+                             options=options, runner=runner)
+    zms = sorted(int(r.get_tag('zm')) for r in bam_lib.BamReader(out))
+    assert zms == [100, 101, 102, 103, 104, 105]
+
+  def test_cli_flags_plumb_to_options(self, scripts_importable):
+    from deepconsensus_tpu import cli
+
+    args = cli.build_parser().parse_args([
+        'run', '--subreads_to_ccs', 'a', '--ccs_bam', 'b',
+        '--checkpoint', 'c', '--output', 'd',
+        '--on_zmw_error', 'ccs-fallback', '--batch_timeout', '12.5',
+        '--batch_retries', '4', '--resume',
+    ])
+    assert args.on_zmw_error == 'ccs-fallback'
+    assert args.batch_timeout == 12.5
+    assert args.batch_retries == 4
+    assert args.resume is True
+
+  def test_classify_error_taxonomy(self):
+    assert faults.classify_error('DEADLINE_EXCEEDED: slice') == 'transient'
+    assert faults.classify_error('watchdog fired') == 'transient'
+    assert faults.classify_error("KeyError: 'pw'") == 'permanent'
+
+  def test_dead_letter_roundtrip(self, tmp_path):
+    path = str(tmp_path / 'x.failed.jsonl')
+    writer = faults.DeadLetterWriter(path)
+    writer.record('z/1/ccs', 'featurize', 'permanent', 'boom', 'skip')
+    writer.record(None, 'decode', 'permanent', 'eof', 'skip')
+    writer.close()
+    entries = faults.read_dead_letters(path)
+    assert [e['zmw'] for e in entries] == ['z/1/ccs', None]
+    assert entries[0]['action'] == 'skip'
