@@ -128,6 +128,18 @@ def _add_run(sub):
                  help='Resume an interrupted run from '
                  '<output>.progress.json + <output>.tmp, replaying the '
                  'feeder past already-committed ZMWs.')
+  p.add_argument('--dispatch_depth', type=int, default=8,
+                 help='Model packs kept in flight on the device before '
+                 'the oldest is drained; raise to hide host-side '
+                 'stacking latency, lower to bound memory.')
+  p.add_argument('--emit_queue_depth', type=int, default=4,
+                 help='Featurize batches buffered between the model '
+                 'stage and the stitch/emit worker before the model '
+                 'stage blocks.')
+  p.add_argument('--no_cross_batch_packing', action='store_true',
+                 help='Pad out each featurize batch\'s model tail '
+                 'instead of packing windows across batches into full '
+                 'fixed-shape model batches (debug/compat).')
 
 
 def _add_train(sub):
@@ -340,6 +352,9 @@ def _dispatch(args) -> int:
         batch_timeout=args.batch_timeout,
         batch_retries=args.batch_retries,
         resume=args.resume,
+        dispatch_depth=args.dispatch_depth,
+        emit_queue_depth=args.emit_queue_depth,
+        pack_across_batches=not args.no_cross_batch_packing,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal
         ),

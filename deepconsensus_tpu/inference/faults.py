@@ -175,18 +175,24 @@ class DeadLetterWriter:
     self.count = 0
 
   def record(self, zmw: Optional[str], stage: str, kind: str, error: str,
-             action: str) -> None:
+             action: str, extra: Optional[Dict[str, Any]] = None) -> None:
     if self._f is None:
       self._f = open(self.path, 'a' if self._append else 'w')
+    entry = {
+        'zmw': zmw,
+        'stage': stage,
+        'kind': kind,
+        'error': error[:4000],
+        'action': action,
+        'time': time.time(),
+    }
+    if extra:
+      # e.g. packed-batch attribution: which model pack failed and how
+      # many of this molecule's windows rode in it, so a replay can
+      # reconstruct the shared root cause across member ZMWs.
+      entry.update(extra)
     json.dump(
-        {
-            'zmw': zmw,
-            'stage': stage,
-            'kind': kind,
-            'error': error[:4000],
-            'action': action,
-            'time': time.time(),
-        },
+        entry,
         self._f,
     )
     self._f.write('\n')
@@ -240,10 +246,13 @@ class Quarantine:
       stage: str,
       error: BaseException | str,
       fallback: Optional[Callable[[], Optional[CcsFallback]]] = None,
+      extra: Optional[Dict[str, Any]] = None,
   ) -> Optional[CcsFallback]:
     """Quarantines one ZMW. fallback is a thunk (evaluated only under
     the ccs-fallback policy) producing the draft-CCS payload, or None
-    when no draft is recoverable (the quarantine downgrades to skip)."""
+    when no draft is recoverable (the quarantine downgrades to skip).
+    extra rides into the dead-letter line — model-pack failures use it
+    to attribute one shared device fault to every member molecule."""
     if self.policy == OnZmwError.FAIL:
       if isinstance(error, BaseException):
         raise error
@@ -270,7 +279,7 @@ class Quarantine:
       else:
         self.counters['n_zmw_skipped_on_error'] += 1
       if self.dead_letter is not None:
-        self.dead_letter.record(zmw, stage, kind, text, action)
+        self.dead_letter.record(zmw, stage, kind, text, action, extra=extra)
     log.warning('quarantined zmw=%s stage=%s kind=%s action=%s: %s',
                 zmw, stage, kind, action, text.splitlines()[-1] if text
                 else text)

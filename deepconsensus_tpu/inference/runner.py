@@ -82,6 +82,16 @@ class InferenceOptions:
   # compute of batch i. Device-side cost per in-flight batch is one
   # uint8 input buffer (~21 MB at b1024) + tiny outputs.
   dispatch_depth: int = 8
+  # Cross-batch window packing: model batches are cut from a window
+  # buffer spanning featurize batches, so the compiled forward runs
+  # full except for one end-of-input tail (False reverts to per-
+  # featurize-batch packs, each padded to batch_size).
+  pack_across_batches: bool = True
+  # Bounded hand-off queue between the model stage and the stitch/emit
+  # worker thread, in featurize batches. Deeper absorbs longer emit
+  # stalls (slow disk) before the device pipeline feels them; each
+  # queued batch holds its windows' output arrays (~2*L bytes/window).
+  emit_queue_depth: int = 4
   # Fault tolerance (inference/faults.py). on_zmw_error governs the
   # per-ZMW quarantine: 'fail' keeps historical fail-fast semantics,
   # 'skip' drops the ZMW (dead-lettered), 'ccs-fallback' emits the
@@ -359,8 +369,10 @@ class ModelRunner:
   def finalize(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
     """Resolves a dispatch into (base ids [n, L], quality [n, L])."""
     pred_ids, max_prob, n = dispatched
-    pred_ids = np.asarray(pred_ids[:n])
-    max_prob = np.asarray(max_prob[:n])
+    # Slice on the host: indexing the device array with a varying [:n]
+    # would lower (and cache) a fresh jitted slice per tail size.
+    pred_ids = np.asarray(pred_ids)[:n]
+    max_prob = np.asarray(max_prob)[:n]
     error_prob = np.maximum(1.0 - max_prob, 1e-12)
     quality = -10.0 * np.log10(error_prob)
     opts = self.options
@@ -536,6 +548,214 @@ def process_skipped_window(
       rq=feature_dict['rq'],
       rg=feature_dict['rg'],
   )
+
+
+def _ccs_quals_array(bq_scores, options: InferenceOptions) -> np.ndarray:
+  """CCS base qualities -> emitted phred uint8 (calibration, cap at
+  max_base_quality, floor at 0) — the quality half of
+  process_skipped_window without the string round-trip."""
+  quals = np.asarray(bq_scores)
+  if options.ccs_calibration_values.enabled:
+    quals = calibration_lib.calibrate_quality_scores(
+        quals, options.ccs_calibration_values
+    )
+  quals = np.minimum(quals, options.max_base_quality).astype(np.int32)
+  return np.maximum(quals, 0).astype(np.uint8)
+
+
+def skipped_window_arrays(
+    feature_dict: Dict[str, Any], options: InferenceOptions
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Array-native process_skipped_window: (vocab ids uint8 [L],
+  phred uint8 [L]) adopted from the draft CCS. Copies out of the
+  feature tensor, so the backing shm segment can be released."""
+  rows = feature_dict['subreads']
+  ccs_range = row_indices(options.max_passes, options.use_ccs_bq)[4]
+  ids = rows[ccs_range[0], :, 0].astype(np.uint8)
+  return ids, _ccs_quals_array(
+      feature_dict['ccs_base_quality_scores'], options)
+
+
+class _MolState:
+  """One molecule's windows accumulating toward stitch/emit.
+
+  Entries are appended in the legacy prediction order (skip windows
+  first, then model windows, each in featurize order) so the stable
+  in-stitch sort reproduces the string plane's byte-exact output.
+  Model windows are appended as placeholders and filled in when their
+  pack finalizes; model_entries keeps each one's draft-CCS copy so a
+  failed pack can adopt the CCS without the (released) feature tensor.
+  """
+
+  __slots__ = ('name', 'batch', 'meta', 'pos', 'ids', 'quals',
+               'model_entries', 'status')
+
+  def __init__(self, name: str, batch: '_BatchState', meta: Tuple):
+    self.name = name
+    self.batch = batch
+    self.meta = meta  # (ec, np_num_passes, rq, rg)
+    self.pos: List[int] = []
+    self.ids: List[Optional[np.ndarray]] = []
+    self.quals: List[Optional[np.ndarray]] = []
+    self.model_entries: List[Tuple[int, np.ndarray, np.ndarray]] = []
+    self.status = 'ok'  # ok | adopted (ccs-fallback) | dropped
+
+  def append_resolved(self, window_pos: int, ids: np.ndarray,
+                      quals: np.ndarray) -> None:
+    self.pos.append(window_pos)
+    self.ids.append(ids)
+    self.quals.append(quals)
+
+  def append_pending(self, window_pos: int, ccs_ids: np.ndarray,
+                     ccs_bq: np.ndarray) -> int:
+    idx = len(self.pos)
+    self.pos.append(window_pos)
+    self.ids.append(None)
+    self.quals.append(None)
+    self.model_entries.append((idx, ccs_ids, ccs_bq))
+    self.batch.pending += 1
+    return idx
+
+  def set_result(self, idx: int, ids: Optional[np.ndarray],
+                 quals: Optional[np.ndarray]) -> None:
+    """Resolves one model slot (ids=None marks a failed pack's slot).
+    Always decrements the batch's pending count, even for molecules
+    already adopted/dropped by an earlier pack failure."""
+    if self.status == 'ok' and ids is not None:
+      self.ids[idx] = ids
+      self.quals[idx] = quals
+    self.batch.pending -= 1
+
+  def adopt_ccs(self, options: InferenceOptions) -> bool:
+    """ccs-fallback for a model-stage fault: every model window (in
+    this pack, other packs, resolved or not) adopts its draft CCS so
+    the molecule degrades consistently, like the string plane's whole-
+    molecule fallback."""
+    for idx, ccs_ids, ccs_bq in self.model_entries:
+      self.ids[idx] = ccs_ids
+      self.quals[idx] = _ccs_quals_array(ccs_bq, options)
+    return True
+
+
+class _BatchState:
+  """Completion tracker for one featurize batch flowing through the
+  packed model stage toward the stitch/emit worker."""
+
+  __slots__ = ('feat', 'mols', 'pending', 'featurized', 'n_windows')
+
+  def __init__(self, feat: Dict[str, Any]):
+    self.feat = feat
+    self.mols: Dict[str, _MolState] = {}
+    self.pending = 0
+    self.featurized = False
+    self.n_windows = 0
+
+  def mol(self, fd: Dict[str, Any]) -> _MolState:
+    name = (fd['name'] if isinstance(fd['name'], str)
+            else fd['name'].decode())
+    state = self.mols.get(name)
+    if state is None:
+      state = self.mols[name] = _MolState(
+          name, self,
+          (fd['ec'], fd['np_num_passes'], fd['rq'], fd['rg']))
+    return state
+
+  @property
+  def complete(self) -> bool:
+    return self.featurized and self.pending == 0
+
+
+class _WindowPacker:
+  """Cross-batch window packer feeding the fixed-shape compiled forward.
+
+  Formatted model-input rows accumulate across featurize batches; full
+  batch_size packs are cut and dispatched as soon as they exist, so in
+  steady state the forward never runs padded and the dispatch pipeline
+  never drains at featurize-batch seams (only the end-of-input tail
+  pads). Up to dispatch_depth packs stay in flight; draining the oldest
+  scatters its (ids, quals) rows back to their molecules via slots.
+
+  A pack that fails to dispatch or finalize is routed to
+  on_pack_failure(slots, pack_seq, error) — slot bookkeeping plus
+  per-member-molecule quarantine happen there.
+  """
+
+  def __init__(self, runner: ModelRunner, options: InferenceOptions,
+               timing_rows: List[Dict[str, Any]], on_pack_failure):
+    self._runner = runner
+    self._batch = options.batch_size
+    self._depth = max(1, options.dispatch_depth)
+    self._timing_rows = timing_rows
+    self._on_pack_failure = on_pack_failure
+    self._rows: List[np.ndarray] = []
+    self._slots: List[Tuple[_MolState, int]] = []
+    self._buffered = 0
+    self._in_flight: 'collections.deque' = collections.deque()
+    self.n_packs = 0
+    self.n_pack_rows = 0
+    self.n_pad_rows = 0
+    self.model_wall = 0.0
+
+  def add(self, rows: np.ndarray, slots: List[Tuple[_MolState, int]]):
+    """Buffers one featurize batch's formatted model rows ([k, R, L, 1],
+    aligned with slots) and dispatches every full pack now cuttable."""
+    self._rows.append(rows)
+    self._slots.extend(slots)
+    self._buffered += len(rows)
+    self._cut_packs(flush=False)
+
+  def _cut_packs(self, flush: bool) -> None:
+    while self._buffered >= self._batch or (flush and self._buffered):
+      if len(self._rows) > 1:
+        self._rows = [np.concatenate(self._rows)]
+      buf = self._rows[0]
+      n = min(self._batch, self._buffered)
+      pack, rest = buf[:n], buf[n:]
+      self._rows = [rest] if len(rest) else []
+      slots = self._slots[:n]
+      del self._slots[:n]
+      self._buffered -= n
+      self._dispatch(pack, slots)
+
+  def _dispatch(self, pack: np.ndarray, slots) -> None:
+    seq = self.n_packs
+    self.n_packs += 1
+    self.n_pack_rows += len(pack)
+    self.n_pad_rows += self._batch - len(pack)
+    try:
+      handle = self._runner.dispatch(pack)
+    except Exception as e:
+      self._on_pack_failure(slots, seq, e)
+      return
+    self._in_flight.append((handle, slots, seq))
+    while len(self._in_flight) > self._depth:
+      self._drain_one()
+
+  def _drain_one(self) -> None:
+    handle, slots, seq = self._in_flight.popleft()
+    t0 = time.time()
+    try:
+      pred_ids, quality = self._runner.finalize(handle)
+    except Exception as e:
+      self._on_pack_failure(slots, seq, e)
+      return
+    # uint8 transport into the stitch plane (values are 0..4 / 0..93).
+    ids_u8 = pred_ids.astype(np.uint8)
+    quals_u8 = quality.astype(np.uint8)
+    elapsed = time.time() - t0
+    self.model_wall += elapsed
+    for (mol, idx), row_ids, row_quals in zip(slots, ids_u8, quals_u8):
+      mol.set_result(idx, row_ids, row_quals)
+    self._timing_rows.append(dict(
+        stage='run_model', runtime=elapsed, n_zmws=0,
+        n_examples=len(slots), n_subreads=0))
+
+  def flush(self, drain: bool = True) -> None:
+    """Cuts the sub-batch tail as a final (padded) pack; with drain,
+    also resolves every in-flight pack (end of input)."""
+    self._cut_packs(flush=True)
+    while drain and self._in_flight:
+      self._drain_one()
 
 
 def _triage_windows(
@@ -744,30 +964,29 @@ def run_inference(
             header_text += '\n'
     writer = BamWriter(out_tmp, header_text=header_text, append=resuming)
 
-    def emit(fastq_str: str, dc_outputs) -> None:
-      name, seq, _, qual = fastq_str.rstrip('\n').split('\n')
-      first = dc_outputs[0]
+    def emit_read(name: str, seq: bytes, quals: np.ndarray, meta) -> None:
+      ec, np_passes, rq, rg = meta
       tags = {}
-      if first.ec is not None:
-        tags['ec'] = float(first.ec)
-      if first.np_num_passes is not None:
-        tags['np'] = int(first.np_num_passes)
-      if first.rq is not None:
-        tags['rq'] = float(first.rq)
-      if first.rg is not None:
-        tags['RG'] = str(first.rg)
+      if ec is not None:
+        tags['ec'] = float(ec)
+      if np_passes is not None:
+        tags['np'] = int(np_passes)
+      if rq is not None:
+        tags['rq'] = float(rq)
+      if rg is not None:
+        tags['RG'] = str(rg)
       # Non-PacBio names (e.g. ccs_fasta inputs with plain names) have
       # no movie/zmw/type structure; omit the zm tag rather than crash.
-      parts = name[1:].split('/')
+      parts = name.split('/')
       if len(parts) >= 2:
         try:
           tags['zm'] = int(parts[1])
         except ValueError:
           pass
       writer.write(
-          name[1:],
-          seq,
-          np.array(phred.quality_string_to_array(qual), dtype=np.uint8),
+          name,
+          seq.decode('ascii'),
+          np.asarray(quals, dtype=np.uint8),
           tags=tags,
       )
 
@@ -777,9 +996,9 @@ def run_inference(
   else:
     writer = open(out_tmp, 'ab' if resuming else 'wb')
 
-    def emit(fastq_str: str, dc_outputs) -> None:
-      del dc_outputs
-      writer.write(fastq_str.encode('ascii'))
+    def emit_read(name: str, seq: bytes, quals: np.ndarray, meta) -> None:
+      del meta
+      writer.write(stitch.format_fastq_bytes(name, seq, quals))
 
     close_out = writer.close
     sink_flush = writer.flush
@@ -912,7 +1131,7 @@ def run_inference(
       def emit_fallback(fb) -> None:
         """Emits a quarantined ZMW's draft CCS read (ccs-fallback)."""
         nonlocal fastq_lines
-        fastq = stitch.fallback_to_fastq(
+        result = stitch.fallback_to_arrays(
             fb.molecule_name,
             fb.sequence,
             fb.quality_scores,
@@ -921,130 +1140,20 @@ def run_inference(
             max_base_quality=options.max_base_quality,
             counter=window_counter,
         )
-        if fastq is None:
+        if result is None:
           return
-        emit(fastq, [stitch.DCModelOutput(
-            molecule_name=fb.molecule_name, window_pos=0, ec=fb.ec,
-            np_num_passes=fb.np_num_passes, rq=fb.rq, rg=fb.rg)])
+        emit_read(fb.molecule_name, result[0], result[1],
+                  (fb.ec, fb.np_num_passes, fb.rq, fb.rg))
         fastq_lines += 1
 
-      def consume_batch(feat):
-        try:
-          _consume_batch(feat)
-        finally:
-          release_shm(feat)
-        if options.end_after_stage == 'full' and 'groups_end' in feat:
-          # Durability point: flush the sink so the manifest's
-          # (groups_done, tmp_size) pair names a valid output prefix
-          # that --resume can truncate back to.
-          sink_flush()
-          manifest.commit(
-              groups_done=feat['groups_end'],
-              tmp_size=sink_tell(),
-              source=source,
-              last_zmw=feat.get('last_zmw'),
-          )
-
-      def _consume_batch(feat):
-        nonlocal fastq_lines
-        all_windows = feat['windows']
-        n_subreads = feat['n_subreads']
-        n_batch_zmws = feat['n_zmws']
-        for zmw_counter in feat['counters']:
-          window_counter.update(zmw_counter)
-        t1 = time.time()
-        if options.end_after_stage == 'tf_examples':
-          timing_rows.append(
-              dict(stage='preprocess', runtime=feat['preprocess_time'],
-                   n_zmws=n_batch_zmws, n_examples=len(all_windows),
-                   n_subreads=n_subreads))
-          return
-        to_model, to_skip = _triage_windows(all_windows, options,
-                                            window_counter)
-        predictions = [
-            process_skipped_window(fd, options) for fd in to_skip
-        ]
-        try:
-          predictions.extend(
-              run_model_on_windows(to_model, runner, params, options)
-          )
-        except Exception as e:
-          if quarantine is None:
-            raise
-          # Per-ZMW degradation of a model-stage failure: adopt the CCS
-          # bases/qualities for each affected molecule's windows
-          # (ccs-fallback) or drop those molecules entirely (skip).
-          def mol(fd):
-            return (fd['name'] if isinstance(fd['name'], str)
-                    else fd['name'].decode())
-
-          dropped = set()
-          for name, fds in itertools.groupby(
-              sorted(to_model, key=mol), key=mol):
-            fds = list(fds)
-            adopted = quarantine.handle(
-                name, 'model', e,
-                fallback=lambda fds=fds: [
-                    process_skipped_window(fd, options) for fd in fds
-                ],
-            )
-            if adopted:
-              predictions.extend(adopted)
-            else:
-              dropped.add(name)
-          if dropped:
-            predictions = [
-                p for p in predictions if p.molecule_name not in dropped
-            ]
-        t2 = time.time()
-        if options.end_after_stage == 'run_model':
-          timing_rows.append(
-              dict(stage='run_model', runtime=t2 - t1,
-                   n_zmws=n_batch_zmws, n_examples=len(all_windows),
-                   n_subreads=n_subreads))
-          return
-        predictions.sort(key=lambda p: (p.molecule_name, p.window_pos))
-        for name, group in itertools.groupby(
-            predictions, key=lambda p: p.molecule_name
-        ):
-          group = list(group)
-          try:
-            fastq = stitch.stitch_to_fastq(
-                molecule_name=name,
-                predictions=group,
-                max_length=options.max_length,
-                min_quality=options.min_quality,
-                min_length=options.min_length,
-                outcome_counter=outcome,
-            )
-            if fastq is not None:
-              emit(fastq, group)
-              fastq_lines += 1
-          except Exception as e:
-            if quarantine is None:
-              raise
-            # No draft CCS survives to this stage; stitch faults can
-            # only skip the molecule.
-            quarantine.handle(name, 'stitch', e, fallback=None)
-        for fb in feat.get('fallbacks', ()):
-          emit_fallback(fb)
-        t3 = time.time()
-        timing_rows.extend([
-            dict(stage='preprocess', runtime=feat['preprocess_time'],
-                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
-                 n_subreads=n_subreads),
-            dict(stage='run_model', runtime=t2 - t1, n_zmws=n_batch_zmws,
-                 n_examples=len(all_windows), n_subreads=n_subreads),
-            dict(stage='stitch_and_write_fastq', runtime=t3 - t2,
-                 n_zmws=n_batch_zmws, n_examples=len(all_windows),
-                 n_subreads=n_subreads),
-        ])
-
-      # Cross-batch pipelining: a producer thread reads BAMs and
-      # featurizes batch N+1 while the main thread runs batch N through
-      # the model and stitcher. Counter discipline: the producer owns
-      # the feeder's `counter`; the main thread accumulates into
-      # `window_counter` and the two merge in the sidecar epilogue.
+      # Three-stage pipeline: featurize (producer thread) -> model
+      # (main thread: triage + cross-batch packer + dispatch pipeline)
+      # -> stitch/emit (dedicated worker thread behind a bounded
+      # queue), so device forwards never wait on postprocess or disk.
+      # Counter discipline: the producer owns the feeder's `counter`;
+      # the main thread updates window triage counts, the emit worker
+      # updates outcome/fallback counts (disjoint keys), and everything
+      # merges in the sidecar epilogue.
       import queue as queue_lib
       import threading
 
@@ -1113,10 +1222,194 @@ def run_inference(
         except BaseException as e:  # surface worker failures to the main thread
           put(('error', e))
 
+      full_mode = options.end_after_stage == 'full'
+      model_mode = options.end_after_stage in ('run_model', 'full')
+      crash_after = faults.injected_crash_after_batches()
+      ccs_row = row_indices(options.max_passes, options.use_ccs_bq)[4][0]
+      states: 'collections.deque[_BatchState]' = collections.deque()
+
+      def on_pack_failure(slots, pack_seq: int, error) -> None:
+        """Attributes a packed-batch failure to its member molecules:
+        each affected molecule is quarantined once (adopting its draft
+        CCS under ccs-fallback, or dropped under skip), with the pack id
+        and its window count recorded in the dead-letter entry."""
+        for mol, idx in slots:
+          mol.set_result(idx, None, None)
+        if quarantine is None:
+          raise error
+        members: Dict[_MolState, int] = {}
+        for mol, _ in slots:
+          members[mol] = members.get(mol, 0) + 1
+        for mol, n_in_pack in members.items():
+          if mol.status != 'ok':
+            continue  # already quarantined by an earlier failed pack
+          adopted = quarantine.handle(
+              mol.name, 'model', error,
+              fallback=lambda m=mol: m.adopt_ccs(options),
+              extra={'model_pack': pack_seq,
+                     'n_windows_in_pack': n_in_pack},
+          )
+          mol.status = 'adopted' if adopted else 'dropped'
+
+      packer: Optional[_WindowPacker] = None
+      if model_mode:
+        packer = _WindowPacker(runner, options, timing_rows,
+                               on_pack_failure)
+
+      def ingest_batch(feat) -> None:
+        """Main-thread stage: triage a featurize batch, copy what the
+        emit stage will need out of shm, and feed model windows to the
+        packer. The batch's _BatchState completes (and becomes eligible
+        for emit) once every pack containing its windows has drained."""
+        for zmw_counter in feat['counters']:
+          window_counter.update(zmw_counter)
+        all_windows = feat['windows']
+        timing_rows.append(
+            dict(stage='preprocess', runtime=feat['preprocess_time'],
+                 n_zmws=feat['n_zmws'], n_examples=len(all_windows),
+                 n_subreads=feat['n_subreads']))
+        if not model_mode:  # tf_examples: featurization was the point
+          return
+        state = _BatchState(feat)
+        state.n_windows = len(all_windows)
+        to_model, to_skip = _triage_windows(all_windows, options,
+                                            window_counter)
+        for fd in to_skip:
+          state.mol(fd).append_resolved(
+              fd['window_pos'], *skipped_window_arrays(fd, options))
+        slots: List[Tuple[_MolState, int]] = []
+        for fd in to_model:
+          mol = state.mol(fd)
+          # Copies: the feature tensors may live in shm segments that
+          # are released as soon as this function returns.
+          ccs_ids = fd['subreads'][ccs_row, :, 0].astype(np.uint8)
+          ccs_bq = np.array(fd['ccs_base_quality_scores'])
+          slots.append(
+              (mol,
+               mol.append_pending(fd['window_pos'], ccs_ids, ccs_bq)))
+        if to_model:
+          raw = np.stack([fd['subreads'] for fd in to_model])
+          rows = data_lib.format_rows_batch(raw, params)
+          packer.add(rows, slots)
+          if not options.pack_across_batches:
+            # Compat/debug mode: pad out this batch's tail instead of
+            # carrying it into the next featurize batch's pack.
+            packer.flush(drain=False)
+        feat['windows'] = None
+        state.featurized = True
+        states.append(state)
+
+      emit_queue: Optional['queue_lib.Queue'] = None
+      emit_thread: Optional[threading.Thread] = None
+      emit_error: List[Optional[BaseException]] = [None]
+      emit_stop = threading.Event()
+
+      def check_emit() -> None:
+        if emit_error[0] is not None:
+          raise emit_error[0]
+
+      def emit_batch_state(state: _BatchState) -> None:
+        """Emit-worker stage: stitch + filter + write one featurize
+        batch's molecules (sorted by name, matching the string plane's
+        global (name, pos) sort order), then its ccs-fallback reads,
+        then commit the progress manifest — only after the sink flushed
+        this batch's bytes, preserving the durability contract."""
+        nonlocal fastq_lines
+        feat = state.feat
+        t0 = time.time()
+        for name in sorted(state.mols):
+          mol = state.mols[name]
+          if mol.status == 'dropped':
+            continue
+          try:
+            result = stitch.stitch_arrays(
+                name,
+                np.asarray(mol.pos, dtype=np.int64),
+                np.stack(mol.ids),
+                np.stack(mol.quals),
+                max_length=options.max_length,
+                min_quality=options.min_quality,
+                min_length=options.min_length,
+                outcome_counter=outcome,
+            )
+            if result is not None:
+              emit_read(name, result[0], result[1], mol.meta)
+              fastq_lines += 1
+          except Exception as e:
+            if quarantine is None:
+              raise
+            # No draft CCS survives to this stage; stitch faults can
+            # only skip the molecule.
+            quarantine.handle(name, 'stitch', e, fallback=None)
+        for fb in feat.get('fallbacks', ()):
+          emit_fallback(fb)
+        timing_rows.append(
+            dict(stage='stitch_and_write_fastq',
+                 runtime=time.time() - t0, n_zmws=feat['n_zmws'],
+                 n_examples=state.n_windows,
+                 n_subreads=feat['n_subreads']))
+        if 'groups_end' in feat:
+          # Durability point: flush the sink so the manifest's
+          # (groups_done, tmp_size) pair names a valid output prefix
+          # that --resume can truncate back to.
+          sink_flush()
+          manifest.commit(
+              groups_done=feat['groups_end'],
+              tmp_size=sink_tell(),
+              source=source,
+              last_zmw=feat.get('last_zmw'),
+          )
+
+      def emit_worker() -> None:
+        emitted = 0
+        try:
+          while not emit_stop.is_set():
+            try:
+              state = emit_queue.get(timeout=0.2)
+            except queue_lib.Empty:
+              continue
+            if state is None:
+              return
+            emit_batch_state(state)
+            emitted += 1
+            if crash_after and emitted >= crash_after:
+              raise RuntimeError(
+                  f'injected crash after {emitted} batch(es) '
+                  f'({faults.ENV_CRASH_AFTER_BATCHES})'
+              )
+        except BaseException as e:  # surfaced via check_emit()
+          emit_error[0] = e
+
+      def emit_put(state) -> None:
+        """Bounded put that surfaces an emit-worker death instead of
+        blocking forever on its abandoned queue."""
+        while True:
+          check_emit()
+          try:
+            emit_queue.put(state, timeout=0.5)
+            return
+          except queue_lib.Full:
+            continue
+
+      def pop_ready() -> None:
+        """Hands completed featurize batches to the emit worker, in
+        featurize order (pack completion is monotone in that order
+        because packs drain FIFO, so per-batch emission order — and
+        resume byte-identity — are preserved)."""
+        while states and states[0].complete:
+          state = states.popleft()
+          if emit_thread is not None:
+            emit_put(state)
+
+      if full_mode:
+        emit_queue = queue_lib.Queue(
+            maxsize=max(1, options.emit_queue_depth))
+        emit_thread = threading.Thread(target=emit_worker, daemon=True)
+        emit_thread.start()
+
       thread = threading.Thread(target=producer, daemon=True)
       thread.start()
-      crash_after = faults.injected_crash_after_batches()
-      batches_consumed = 0
+      batches_ingested = 0
       try:
         while True:
           kind, payload = feat_queue.get()
@@ -1124,16 +1417,44 @@ def run_inference(
             break
           if kind == 'error':
             raise payload
-          consume_batch(payload)
-          batches_consumed += 1
-          if crash_after and batches_consumed >= crash_after:
+          try:
+            check_emit()
+            ingest_batch(payload)
+          finally:
+            release_shm(payload)
+          pop_ready()
+          batches_ingested += 1
+          if (crash_after and emit_thread is None
+              and batches_ingested >= crash_after):
+            # Without an emit stage the main thread is the whole
+            # consumer; with one, the injection moves there so the
+            # crash still lands just after a manifest commit (see
+            # emit_worker).
             raise RuntimeError(
-                f'injected crash after {batches_consumed} batch(es) '
+                f'injected crash after {batches_ingested} batch(es) '
                 f'({faults.ENV_CRASH_AFTER_BATCHES})'
             )
+        if packer is not None:
+          packer.flush()  # end of input: cut the tail pack, drain all
+        pop_ready()
+        if states:
+          raise RuntimeError(
+              f'{len(states)} featurize batch(es) never completed the '
+              'model stage (packer accounting bug)')
+        if emit_thread is not None:
+          emit_put(None)
+          emit_thread.join()
+          check_emit()
       finally:
         stop.set()
+        emit_stop.set()
         thread.join(timeout=30)
+        if emit_thread is not None:
+          emit_thread.join(timeout=30)
+        if packer is not None:
+          window_counter['n_model_packs'] = packer.n_packs
+          window_counter['n_model_pack_rows'] = packer.n_pack_rows
+          window_counter['n_model_pad_rows'] = packer.n_pad_rows
         if thread.is_alive():
           # Draining now would race the producer's put(); anything it
           # enqueues after our drain would leak its shm segments.

@@ -119,14 +119,32 @@ def bgzf_decompressed_size(path: str) -> Optional[int]:
       total = 0
       while True:
         start = f.tell()
-        hdr = f.read(18)
+        hdr = f.read(12)
         if not hdr:
           return total
-        # gzip magic, deflate, FEXTRA set, XLEN=6, 'BC' subfield len 2.
-        if (len(hdr) < 18 or hdr[:4] != b'\x1f\x8b\x08\x04'
-            or hdr[10:12] != b'\x06\x00' or hdr[12:16] != b'BC\x02\x00'):
+        # gzip magic, deflate, FEXTRA set.
+        if len(hdr) < 12 or hdr[:4] != b'\x1f\x8b\x08\x04':
           return None
-        bsize = int.from_bytes(hdr[16:18], 'little') + 1
+        xlen = int.from_bytes(hdr[10:12], 'little')
+        extra = f.read(xlen)
+        if len(extra) < xlen:
+          return None
+        # Walk the FEXTRA subfields (SI1, SI2, u16 SLEN, data) for the
+        # BGZF 'BC' field; the spec allows other subfields in any
+        # order, so requiring XLEN == 6 would reject legal files.
+        bsize = None
+        off = 0
+        while off + 4 <= xlen:
+          si, slen = extra[off:off + 2], int.from_bytes(
+              extra[off + 2:off + 4], 'little')
+          off += 4
+          if off + slen > xlen:
+            return None  # subfield overruns XLEN: malformed
+          if si == b'BC' and slen == 2:
+            bsize = int.from_bytes(extra[off:off + 2], 'little') + 1
+          off += slen
+        if bsize is None or off != xlen:
+          return None
         f.seek(start + bsize - 4)
         isize = f.read(4)
         if len(isize) < 4:

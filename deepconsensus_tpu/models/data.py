@@ -626,7 +626,11 @@ def augment_batch(
     blocks = rows[:, : 4 * p, :, 0].reshape(b, 4, p, length)
     for bi, cap in ((1, params.PW_MAX), (2, params.IP_MAX)):
       block = blocks[:, bi]
-      delta = rng.integers(-1, 2, size=block.shape).astype(rows.dtype)
+      # Draw from {-1, +1}: integers(-1, 2) would include 0 and silently
+      # cut the effective jitter rate to ~17% of entries.
+      delta = (rng.integers(0, 2, size=block.shape) * 2 - 1).astype(
+          rows.dtype
+      )
       mask = (
           jit_on[:, None, None]
           & (block > 0)

@@ -170,6 +170,10 @@ int dc_gzip_decompress(const uint8_t* data, size_t len, uint8_t** out,
   // len mod 2^32 (possibly decoding a clean prefix and returning 0).
   if (len > UINT_MAX) return 5;
   size_t cap = len * 4 + (1 << 16);
+  // Clamp to max_out + 1: one byte past the cap is all the over-cap
+  // check below needs, and it keeps the allocation bounded by the
+  // caller's budget instead of transiently ~2x over it.
+  if (max_out && cap > max_out + 1) cap = max_out + 1;
   uint8_t* buffer = (uint8_t*)malloc(cap);
   if (!buffer) return 2;
   size_t total = 0;
@@ -186,6 +190,7 @@ int dc_gzip_decompress(const uint8_t* data, size_t len, uint8_t** out,
   for (;;) {
     if (total == cap) {
       cap *= 2;
+      if (max_out && cap > max_out + 1) cap = max_out + 1;
       uint8_t* grown = (uint8_t*)realloc(buffer, cap);
       if (!grown) {
         inflateEnd(&zs);

@@ -87,7 +87,7 @@ def format_as_fastq(name: str, sequence: str, quality_string: str) -> str:
   return f'@{name}\n{sequence}\n+\n{quality_string}\n'
 
 
-def fallback_to_fastq(
+def fallback_to_arrays(
     molecule_name: str,
     sequence: str,
     quality_scores,
@@ -95,13 +95,13 @@ def fallback_to_fastq(
     min_length: int,
     max_base_quality: int,
     counter,
-) -> Optional[str]:
-  """Formats a quarantined ZMW's draft CCS read (--on-zmw-error=
-  ccs-fallback) with its original base qualities, applying the same
-  min_quality/min_length gates as stitched reads. Counted under
-  n_fallback_* keys — deliberately not OutcomeCounter, so `success`
-  keeps meaning "model-polished reads" and fallback yield stays
-  separately accountable."""
+) -> Optional[Tuple[bytes, np.ndarray]]:
+  """Array-native core of fallback_to_fastq: gates a quarantined ZMW's
+  draft CCS read and returns (sequence bytes, phred uint8 array), or
+  None when filtered. Counted under n_fallback_* keys — deliberately
+  not OutcomeCounter, so `success` keeps meaning "model-polished reads"
+  and fallback yield stays separately accountable."""
+  del molecule_name  # kept for call-site symmetry with stitch_arrays
   if not sequence:
     counter['n_fallback_empty'] += 1
     return None
@@ -115,8 +115,29 @@ def fallback_to_fastq(
     counter['n_fallback_failed_length_filter'] += 1
     return None
   counter['n_fallback_emitted'] += 1
+  return sequence.encode('ascii'), quals.astype(np.uint8)
+
+
+def fallback_to_fastq(
+    molecule_name: str,
+    sequence: str,
+    quality_scores,
+    min_quality: int,
+    min_length: int,
+    max_base_quality: int,
+    counter,
+) -> Optional[str]:
+  """String-plane wrapper over fallback_to_arrays (legacy API)."""
+  result = fallback_to_arrays(
+      molecule_name, sequence, quality_scores, min_quality, min_length,
+      max_base_quality, counter,
+  )
+  if result is None:
+    return None
+  seq_bytes, quals = result
   return format_as_fastq(
-      molecule_name, sequence, phred.quality_scores_to_string(quals)
+      molecule_name, seq_bytes.decode('ascii'),
+      phred.quality_scores_to_string(quals),
   )
 
 
@@ -146,3 +167,56 @@ def stitch_to_fastq(
     return None
   outcome_counter.success += 1
   return format_as_fastq(molecule_name, final_seq, final_qual)
+
+
+def stitch_arrays(
+    molecule_name: str,
+    window_pos: np.ndarray,
+    ids: np.ndarray,
+    quals: np.ndarray,
+    max_length: int,
+    min_quality: int,
+    min_length: int,
+    outcome_counter: OutcomeCounter,
+) -> Optional[Tuple[bytes, np.ndarray]]:
+  """Array-native stitch_to_fastq: one molecule's windows as contiguous
+  arrays in, (sequence ASCII bytes, phred uint8 array) out.
+
+  window_pos: [n] window start offsets; ids: [n, L] vocab-id uint8;
+  quals: [n, L] phred uint8. The gap strip, quality gate, and ASCII
+  conversion are each a single vectorized pass — no per-window Python
+  objects or intermediate strings. Filter semantics (and counter
+  attribution) match stitch_to_fastq exactly, including the legacy
+  missing-window rule: sorted window k must not start past
+  k * max_length.
+  """
+  del molecule_name  # name formatting happens at the emit sink
+  n = len(window_pos)
+  order = np.argsort(window_pos, kind='stable')
+  pos = np.asarray(window_pos)[order]
+  if n == 0 or np.any(pos > np.arange(n, dtype=pos.dtype) * max_length):
+    outcome_counter.empty_sequence += 1
+    return None
+  flat_ids = np.ascontiguousarray(ids[order]).reshape(-1)
+  flat_quals = np.ascontiguousarray(quals[order]).reshape(-1)
+  keep = flat_ids != constants.GAP_INT
+  flat_ids = flat_ids[keep]
+  if flat_ids.size == 0:
+    outcome_counter.only_gaps += 1
+    return None
+  flat_quals = flat_quals[keep]
+  if round(phred.avg_phred(flat_quals), 5) < min_quality:
+    outcome_counter.failed_quality_filter += 1
+    return None
+  if flat_ids.size < min_length:
+    outcome_counter.failed_length_filter += 1
+    return None
+  outcome_counter.success += 1
+  return phred.encoded_sequence_to_bytes(flat_ids), flat_quals
+
+
+def format_fastq_bytes(name: str, seq: bytes, quals: np.ndarray) -> bytes:
+  """(name, sequence bytes, phred uint8) -> one FASTQ record's bytes."""
+  return b'@%s\n%s\n+\n%s\n' % (
+      name.encode('ascii'), seq, phred.quality_scores_to_bytes(quals)
+  )

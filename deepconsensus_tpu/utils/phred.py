@@ -18,6 +18,24 @@ def encoded_sequence_to_string(encoded_sequence: np.ndarray) -> str:
   return constants.VOCAB_BYTES[idx].tobytes().decode('ascii')
 
 
+def encoded_sequence_to_bytes(encoded_sequence: np.ndarray) -> bytes:
+  """Vocab-int array -> ASCII bytes in one LUT gather + tobytes(); the
+  array-native emit path's counterpart of encoded_sequence_to_string
+  (no str round-trip)."""
+  idx = np.asarray(encoded_sequence)
+  if idx.dtype != np.uint8:
+    idx = idx.astype(np.int64)
+  return constants.VOCAB_BYTES[idx].tobytes()
+
+
+def quality_scores_to_bytes(scores: np.ndarray) -> bytes:
+  """Phred int array -> FASTQ quality bytes (offset 33), single pass."""
+  arr = np.asarray(scores)
+  if arr.dtype == np.uint8:
+    return (arr + np.uint8(33)).tobytes()
+  return (arr.astype(np.int64) + 33).astype(np.uint8).tobytes()
+
+
 def quality_score_to_string(score: int) -> str:
   """Phred int -> FASTQ char (offset 33)."""
   return chr(score + 33)

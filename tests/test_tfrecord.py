@@ -208,6 +208,59 @@ def test_bgzf_decompressed_size_probe(tmp_path):
   assert bgzf_decompressed_size(mixed) is None
 
 
+def test_bgzf_decompressed_size_walks_fextra_subfields(tmp_path):
+  """The BC subfield may sit anywhere in FEXTRA alongside other
+  subfields (spec-legal); the probe must walk them rather than require
+  XLEN == 6 — and still report unknown for malformed extras."""
+  import gzip as gzip_lib
+
+  from deepconsensus_tpu.io.tfrecord import bgzf_decompressed_size
+
+  rng = np.random.default_rng(2)
+  records = [rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+             for n in (70_000, 5, 150_000)]
+  raw_len = sum(len(r) + 16 for r in records)
+  plain = str(tmp_path / 'plain.tfrecord.gz')
+  with TFRecordWriter(plain, compression='BGZF') as w:
+    for r in records:
+      w.write(r)
+  data = open(plain, 'rb').read()
+  blocks = []
+  off = 0
+  while off < len(data):
+    bsize = int.from_bytes(data[off + 16:off + 18], 'little') + 1
+    blocks.append(data[off:off + bsize])
+    off += bsize
+
+  sub = b'XY\x04\x00data'  # SI1 SI2, SLEN=4, payload
+
+  def with_extra_subfield(block: bytes) -> bytes:
+    assert block[12:14] == b'BC'
+    new_xlen = int.from_bytes(block[10:12], 'little') + len(sub)
+    bc = bytearray(block[12:18])
+    bc[4:6] = (int.from_bytes(bc[4:6], 'little') + len(sub)).to_bytes(
+        2, 'little')  # BSIZE grows with the header
+    return (block[:10] + new_xlen.to_bytes(2, 'little') + sub
+            + bytes(bc) + block[18:])
+
+  rewritten = str(tmp_path / 'extra.tfrecord.gz')
+  with open(rewritten, 'wb') as f:
+    for block in blocks:
+      f.write(with_extra_subfield(block))
+  assert bgzf_decompressed_size(rewritten) == raw_len
+  # Still a valid gzip stream: zlib skips unknown FEXTRA content.
+  assert len(gzip_lib.decompress(open(rewritten, 'rb').read())) == raw_len
+  # BC SLEN pointing past XLEN: malformed, reports unknown.
+  bad = bytearray(blocks[0])
+  bad[14:16] = (1000).to_bytes(2, 'little')
+  (tmp_path / 'bad.tfrecord.gz').write_bytes(bytes(bad))
+  assert bgzf_decompressed_size(str(tmp_path / 'bad.tfrecord.gz')) is None
+  # FEXTRA present but no BC subfield: not BGZF, reports unknown.
+  nobc = blocks[0][:12] + b'XY\x02\x00ab' + blocks[0][18:]
+  (tmp_path / 'nobc.tfrecord.gz').write_bytes(nobc)
+  assert bgzf_decompressed_size(str(tmp_path / 'nobc.tfrecord.gz')) is None
+
+
 def test_native_gate_uses_decompressed_size(tmp_path, monkeypatch):
   """A shard whose decompressed size exceeds the cap must take the
   streaming path even when its compressed size is tiny (highly
