@@ -156,6 +156,10 @@ def _add_train(sub):
                  help='Config override, repeatable (e.g. '
                  '--set use_pallas_wavefront=true --set loss_reg=0.5).')
   p.add_argument('--checkpoint', help='Warm-start checkpoint.')
+  p.add_argument('--on_shard_error', choices=('fail', 'skip'),
+                 help='Streaming-loader policy for an undecodable '
+                 'shard: fail (default) aborts, skip counts + logs '
+                 'the shard and keeps training.')
   p.add_argument('--tp', type=int, default=1,
                  help='Tensor-parallel mesh size.')
   p.add_argument('--coordinator_address',
@@ -404,6 +408,8 @@ def _dispatch(args) -> int:
     with params.unlocked():
       if args.batch_size:
         params.batch_size = args.batch_size
+      if args.on_shard_error:
+        params.on_shard_error = args.on_shard_error
     if (args.coordinator_address or args.num_processes
         or args.process_id is not None):
       # Initialize before the mesh is built so it spans all hosts

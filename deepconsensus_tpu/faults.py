@@ -1,0 +1,249 @@
+"""Shared fault taxonomy, dead-letter sidecars, and injection hooks.
+
+PR 1 built these primitives for the inference pipeline
+(inference/faults.py); the training loop needs the identical
+transient/permanent classification for its retry loop, the identical
+dead-letter JSONL format for NaN-batch forensics, and its own set of
+env-var fault-injection hooks. Promoting them here makes the two halves
+share one vocabulary: a dead-letter line written by training replays
+with the same tooling as one written by inference.
+
+inference/faults.py re-exports everything below, so existing imports
+keep working unchanged.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# Error taxonomy
+
+
+class FaultKind:
+  TRANSIENT = 'transient'
+  PERMANENT = 'permanent'
+
+
+# Device-runtime signatures (TPU preemption/unavailability) plus
+# host-side pool/timeout signatures.
+_TRANSIENT_MARKERS = (
+    'UNAVAILABLE', 'DEADLINE_EXCEEDED', 'RESOURCE_EXHAUSTED', 'PREEMPT',
+    'timed out', 'Timeout', 'Connection reset', 'Broken pipe',
+    'watchdog',
+)
+
+
+def classify_error(error_text: str) -> str:
+  """Transient (worth retrying) vs permanent (bad data/config) by
+  message."""
+  if any(marker in error_text for marker in _TRANSIENT_MARKERS):
+    return FaultKind.TRANSIENT
+  return FaultKind.PERMANENT
+
+
+class CrashLoopError(RuntimeError):
+  """Raised by run_training_with_retry when restarts stop making
+  progress: the same resume step across K consecutive transient
+  failures means retrying cannot help (e.g. the failure happens before
+  the first new checkpoint every time)."""
+
+
+class NonFiniteTrainingError(RuntimeError):
+  """Raised when the NaN sentinel exhausts its rollback budget (or has
+  no valid checkpoint to roll back to). Permanent by construction: the
+  message carries no transient markers, so the retry loop re-raises
+  instead of looping on a diverged model."""
+
+
+# ----------------------------------------------------------------------
+# Dead-letter sidecar (JSONL, one object per line)
+
+
+class DeadLetterWriter:
+  """Streams quarantined-item records to a .failed.jsonl sidecar.
+
+  One JSON object per line: {zmw, stage, kind, error, action, time}.
+  `zmw` is the per-item id (ZMW name for inference, None for training
+  records, which carry their window ids in `extra`). The file is
+  created lazily on the first record so clean runs leave no empty
+  sidecar; every line is flushed so a later crash can't lose the
+  forensic trail.
+  """
+
+  def __init__(self, path: str, append: bool = False):
+    self.path = path
+    self._append = append
+    self._f = None
+    self.count = 0
+
+  def record(self, zmw: Optional[str], stage: str, kind: str, error: str,
+             action: str, extra: Optional[Dict[str, Any]] = None) -> None:
+    if self._f is None:
+      self._f = open(self.path, 'a' if self._append else 'w')
+    entry = {
+        'zmw': zmw,
+        'stage': stage,
+        'kind': kind,
+        'error': error[:4000],
+        'action': action,
+        'time': time.time(),
+    }
+    if extra:
+      # e.g. packed-batch attribution (inference) or the offending
+      # batch's window ids / fingerprint (training NaN sentinel).
+      entry.update(extra)
+    json.dump(
+        entry,
+        self._f,
+    )
+    self._f.write('\n')
+    self._f.flush()
+    self.count += 1
+
+  def close(self) -> None:
+    if self._f is not None:
+      self._f.close()
+      self._f = None
+
+
+def read_dead_letters(path: str) -> List[Dict[str, Any]]:
+  """Parses a dead-letter sidecar back into records (for replay)."""
+  entries = []
+  with open(path) as f:
+    for line in f:
+      line = line.strip()
+      if line:
+        entries.append(json.loads(line))
+  return entries
+
+
+# ----------------------------------------------------------------------
+# Fault-injection hooks (driven by scripts/inject_faults.py + tests)
+#
+# Inference hooks (ENV_KILL_ZMW / ENV_CRASH_AFTER_BATCHES) target
+# per-item stages; the training hooks below target step boundaries and
+# shard readers. ENV_KILL_TOKEN is shared: pointing it at a path makes
+# any kill-style hook fire exactly once (the first process to create
+# the token file dies; the retried run then succeeds), so recovery is
+# observable rather than an infinite crash loop.
+
+ENV_KILL_ZMW = 'DCTPU_FAULT_KILL_ZMW'
+ENV_KILL_TOKEN = 'DCTPU_FAULT_KILL_TOKEN'
+ENV_CRASH_AFTER_BATCHES = 'DCTPU_FAULT_CRASH_AFTER_BATCHES'
+ENV_NAN_AT_STEP = 'DCTPU_FAULT_NAN_AT_STEP'
+ENV_SIGTERM_AT_STEP = 'DCTPU_FAULT_SIGTERM_AT_STEP'
+ENV_KILL_TRAIN_AT_STEP = 'DCTPU_FAULT_KILL_TRAIN_AT_STEP'
+ENV_KILL_SHARD_READER = 'DCTPU_FAULT_KILL_SHARD_READER'
+
+# Hooks that already fired in this process (consume-once semantics:
+# after a NaN-sentinel rollback the training loop passes the same step
+# numbers again and the injected fault must not re-fire).
+_fired: set = set()
+
+
+def _env_int(name: str) -> int:
+  try:
+    return int(os.environ.get(name, '0'))
+  except ValueError:
+    return 0
+
+
+def _claim_token() -> bool:
+  """True when this process may fire a once-only kill (no token file
+  configured, or this process created it first)."""
+  token = os.environ.get(ENV_KILL_TOKEN)
+  if not token:
+    return True
+  try:
+    fd = os.open(token, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+  except FileExistsError:
+    return False
+  os.close(fd)
+  return True
+
+
+def _fire_once(env_name: str, step: int) -> bool:
+  target = _env_int(env_name)
+  if target <= 0 or step != target or env_name in _fired:
+    return False
+  _fired.add(env_name)
+  return True
+
+
+def maybe_kill_worker(zmw_name: str) -> None:
+  """SIGKILLs the current process when fault injection targets this
+  ZMW. With ENV_KILL_TOKEN set, the kill fires exactly once (the first
+  worker to create the token file dies; retries then succeed) so the
+  watchdog's recovery is observable rather than an infinite loop."""
+  target = os.environ.get(ENV_KILL_ZMW)
+  if not target or target != zmw_name:
+    return
+  if not _claim_token():
+    return
+  import signal
+
+  os.kill(os.getpid(), signal.SIGKILL)
+
+
+def injected_crash_after_batches() -> int:
+  """>0: the consumer loop raises after this many consumed batches."""
+  return _env_int(ENV_CRASH_AFTER_BATCHES)
+
+
+def maybe_poison_batch(step: int, batch: Dict[str, Any]) -> bool:
+  """Overwrites the batch's rows with NaN when ENV_NAN_AT_STEP targets
+  this step (once per process) — the canonical diverged-batch fault the
+  NaN sentinel must absorb."""
+  if not _fire_once(ENV_NAN_AT_STEP, step):
+    return False
+  import numpy as np
+
+  batch['rows'] = np.full_like(batch['rows'], np.nan)
+  log.warning('fault injection: poisoned training batch at step %d', step)
+  return True
+
+
+def maybe_sigterm_at_step(step: int) -> bool:
+  """Delivers SIGTERM to this process at the target step (once per
+  process) — simulates the preemption notice a TPU VM receives."""
+  if not _fire_once(ENV_SIGTERM_AT_STEP, step):
+    return False
+  import signal
+
+  log.warning('fault injection: SIGTERM at step %d', step)
+  os.kill(os.getpid(), signal.SIGTERM)
+  return True
+
+
+def maybe_kill_train_at_step(step: int) -> None:
+  """SIGKILLs the training process at the target step — simulates a
+  hard preemption with no grace period. Honors ENV_KILL_TOKEN for
+  fire-once behavior across restarts."""
+  if _env_int(ENV_KILL_TRAIN_AT_STEP) != step:
+    return
+  if not _claim_token():
+    return
+  import signal
+
+  os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_shard_reader(shard_path: str) -> None:
+  """SIGKILLs the current (shard-reader worker) process when
+  ENV_KILL_SHARD_READER is a substring of the shard path about to be
+  read. Honors ENV_KILL_TOKEN for fire-once behavior."""
+  target = os.environ.get(ENV_KILL_SHARD_READER)
+  if not target or target not in shard_path:
+    return
+  if not _claim_token():
+    return
+  import signal
+
+  os.kill(os.getpid(), signal.SIGKILL)

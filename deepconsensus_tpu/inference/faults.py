@@ -16,6 +16,11 @@ output) don't survive production traffic. This module provides:
 Counterpart of the training-side retry/resume stack
 (models/train.py run_training_with_retry); inference needs per-item
 granularity rather than restart-the-world.
+
+The error taxonomy, dead-letter sidecar, and kill-style injection
+hooks now live in the shared deepconsensus_tpu/faults.py (the training
+loop uses the same primitives); they are re-exported here so existing
+imports keep working.
 """
 from __future__ import annotations
 
@@ -27,15 +32,29 @@ import logging
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
+
+from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
+    ENV_CRASH_AFTER_BATCHES,
+    ENV_KILL_TOKEN,
+    ENV_KILL_ZMW,
+    _TRANSIENT_MARKERS,
+    DeadLetterWriter,
+    FaultKind,
+    classify_error,
+    injected_crash_after_batches,
+    maybe_kill_worker,
+    read_dead_letters,
+)
 
 log = logging.getLogger(__name__)
 
 
 # ----------------------------------------------------------------------
-# Error taxonomy
+# Error taxonomy (inference-side stages; kinds live in the shared
+# deepconsensus_tpu/faults.py)
 
 
 class FaultStage:
@@ -47,27 +66,6 @@ class FaultStage:
   STITCH = 'stitch'        # window stitching / output formatting
 
   ALL = (DECODE, FEATURIZE, MODEL, STITCH)
-
-
-class FaultKind:
-  TRANSIENT = 'transient'
-  PERMANENT = 'permanent'
-
-
-# Markers borrowed from the training retry loop (train.py:690-693) plus
-# host-side pool/timeout signatures.
-_TRANSIENT_MARKERS = (
-    'UNAVAILABLE', 'DEADLINE_EXCEEDED', 'RESOURCE_EXHAUSTED', 'PREEMPT',
-    'timed out', 'Timeout', 'Connection reset', 'Broken pipe',
-    'watchdog',
-)
-
-
-def classify_error(error_text: str) -> str:
-  """Transient (worth retrying) vs permanent (bad data) by message."""
-  if any(marker in error_text for marker in _TRANSIENT_MARKERS):
-    return FaultKind.TRANSIENT
-  return FaultKind.PERMANENT
 
 
 class OnZmwError:
@@ -152,68 +150,6 @@ def fallback_from_ccs_read(ccs_read) -> CcsFallback:
       rq=ccs_read.rq,
       rg=ccs_read.rg,
   )
-
-
-# ----------------------------------------------------------------------
-# Dead-letter sidecar
-
-
-class DeadLetterWriter:
-  """Streams quarantined-ZMW records to <output>.failed.jsonl.
-
-  One JSON object per line: {zmw, stage, kind, error, action, time}.
-  The file is created lazily on the first record so clean runs leave no
-  empty sidecar; every line is flushed so a later crash can't lose the
-  forensic trail. Replay: feed the recorded zmw ids back through
-  --shard-style filtering or scripts/inject_faults.py.
-  """
-
-  def __init__(self, path: str, append: bool = False):
-    self.path = path
-    self._append = append
-    self._f = None
-    self.count = 0
-
-  def record(self, zmw: Optional[str], stage: str, kind: str, error: str,
-             action: str, extra: Optional[Dict[str, Any]] = None) -> None:
-    if self._f is None:
-      self._f = open(self.path, 'a' if self._append else 'w')
-    entry = {
-        'zmw': zmw,
-        'stage': stage,
-        'kind': kind,
-        'error': error[:4000],
-        'action': action,
-        'time': time.time(),
-    }
-    if extra:
-      # e.g. packed-batch attribution: which model pack failed and how
-      # many of this molecule's windows rode in it, so a replay can
-      # reconstruct the shared root cause across member ZMWs.
-      entry.update(extra)
-    json.dump(
-        entry,
-        self._f,
-    )
-    self._f.write('\n')
-    self._f.flush()
-    self.count += 1
-
-  def close(self) -> None:
-    if self._f is not None:
-      self._f.close()
-      self._f = None
-
-
-def read_dead_letters(path: str) -> List[Dict[str, Any]]:
-  """Parses a dead-letter sidecar back into records (for replay)."""
-  entries = []
-  with open(path) as f:
-    for line in f:
-      line = line.strip()
-      if line:
-        entries.append(json.loads(line))
-  return entries
 
 
 # ----------------------------------------------------------------------
@@ -474,37 +410,7 @@ def validate_resume_source(state: Dict[str, Any],
       )
 
 
-# ----------------------------------------------------------------------
-# Fault-injection hooks (driven by scripts/inject_faults.py + tests)
-
-ENV_KILL_ZMW = 'DCTPU_FAULT_KILL_ZMW'
-ENV_KILL_TOKEN = 'DCTPU_FAULT_KILL_TOKEN'
-ENV_CRASH_AFTER_BATCHES = 'DCTPU_FAULT_CRASH_AFTER_BATCHES'
-
-
-def maybe_kill_worker(zmw_name: str) -> None:
-  """SIGKILLs the current process when fault injection targets this
-  ZMW. With ENV_KILL_TOKEN set, the kill fires exactly once (the first
-  worker to create the token file dies; retries then succeed) so the
-  watchdog's recovery is observable rather than an infinite loop."""
-  target = os.environ.get(ENV_KILL_ZMW)
-  if not target or target != zmw_name:
-    return
-  token = os.environ.get(ENV_KILL_TOKEN)
-  if token:
-    try:
-      fd = os.open(token, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-    except FileExistsError:
-      return
-    os.close(fd)
-  import signal
-
-  os.kill(os.getpid(), signal.SIGKILL)
-
-
-def injected_crash_after_batches() -> int:
-  """>0: the consumer loop raises after this many consumed batches."""
-  try:
-    return int(os.environ.get(ENV_CRASH_AFTER_BATCHES, '0'))
-  except ValueError:
-    return 0
+# Fault-injection hooks (ENV_KILL_ZMW / ENV_KILL_TOKEN /
+# ENV_CRASH_AFTER_BATCHES, maybe_kill_worker,
+# injected_crash_after_batches) are re-exported from the shared
+# deepconsensus_tpu/faults.py above.

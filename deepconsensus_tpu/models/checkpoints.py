@@ -1,12 +1,33 @@
 """Checkpoint loading helpers shared by training, inference, eval,
-export, and distillation."""
+export, and distillation — plus the checkpoint-integrity layer
+(per-checkpoint manifests, validation, quarantine).
+
+Integrity model: Trainer.save_checkpoint commits a small JSON manifest
+*after* orbax's wait_until_finished, into
+<ckpt_dir>/.manifests/checkpoint-N.json (atomic write + rename). A
+checkpoint directory without a committed manifest is, by construction,
+one whose save never finished; a directory whose on-disk file sizes
+disagree with the manifest inventory was truncated or tampered with.
+latest_valid_checkpoint() therefore never hands training a half-written
+resume source: invalid candidates are moved to <ckpt_dir>/.quarantine/
+and the newest valid one wins.
+"""
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
-from typing import Any, Dict
+import re
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
+
+_CKPT_NAME_RE = re.compile(r'^checkpoint-(\d+)$')
+MANIFEST_DIRNAME = '.manifests'
+QUARANTINE_DIRNAME = '.quarantine'
+MANIFEST_VERSION = 1
 
 
 def load_params(checkpoint_path: str, params_template=None):
@@ -24,6 +45,10 @@ def load_params(checkpoint_path: str, params_template=None):
   the template's dtype (a bf16-saved checkpoint warm-starting an f32
   run must not silently flip the training dtype).
   """
+  if not os.path.exists(checkpoint_path):
+    raise FileNotFoundError(
+        f'checkpoint path {checkpoint_path!r} does not exist'
+    )
   import orbax.checkpoint as ocp
 
   checkpointer = ocp.StandardCheckpointer()
@@ -60,8 +85,224 @@ def load_params(checkpoint_path: str, params_template=None):
 def load_full_state(checkpoint_path: str) -> Dict[str, Any]:
   """Restores the complete saved dict (params/opt_state/model_state/
   step where present)."""
+  if not os.path.exists(checkpoint_path):
+    raise FileNotFoundError(
+        f'checkpoint path {checkpoint_path!r} does not exist'
+    )
   import orbax.checkpoint as ocp
 
   return ocp.StandardCheckpointer().restore(
       os.path.abspath(checkpoint_path)
   )
+
+
+# ----------------------------------------------------------------------
+# Checkpoint integrity: manifests, validation, quarantine
+
+
+def tree_digest(tree: Any) -> str:
+  """Deterministic sha256 over a checkpoint pytree's leaf CONTENTS
+  (dtype + shape + raw bytes per leaf, combined order-independently).
+  Deliberately structure-agnostic: the save side hashes live optax
+  namedtuples while verify_digest hashes orbax's untyped restore
+  (plain dicts), so leaf paths and flatten order differ between the
+  two even for identical data. Save-time identity for deep
+  verification; validation proper never needs to load arrays."""
+  import jax
+  import numpy as np
+
+  leaf_digests = []
+  for leaf in jax.tree_util.tree_leaves(tree):
+    arr = np.asarray(leaf)
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    leaf_digests.append(h.hexdigest())
+  return hashlib.sha256(
+      ''.join(sorted(leaf_digests)).encode()
+  ).hexdigest()
+
+
+def checkpoint_step(ckpt_path: str) -> Optional[int]:
+  """Step number encoded in a checkpoint-N directory name, else None."""
+  m = _CKPT_NAME_RE.match(os.path.basename(ckpt_path))
+  return int(m.group(1)) if m else None
+
+
+def manifest_path(ckpt_path: str) -> str:
+  ckpt_path = ckpt_path.rstrip(os.sep)
+  return os.path.join(
+      os.path.dirname(ckpt_path), MANIFEST_DIRNAME,
+      os.path.basename(ckpt_path) + '.json',
+  )
+
+
+def _file_inventory(ckpt_path: str) -> Dict[str, int]:
+  """{relative path: size} for every regular file under ckpt_path."""
+  inventory: Dict[str, int] = {}
+  for root, _, files in os.walk(ckpt_path):
+    for name in files:
+      full = os.path.join(root, name)
+      inventory[os.path.relpath(full, ckpt_path)] = os.path.getsize(full)
+  return inventory
+
+
+def write_manifest(ckpt_path: str, step: int,
+                   digest: Optional[str] = None) -> str:
+  """Commits the manifest for a fully-written checkpoint (atomic write
+  + rename). Call only after the checkpointer's wait_until_finished:
+  the manifest's existence IS the commit record."""
+  path = manifest_path(ckpt_path)
+  os.makedirs(os.path.dirname(path), exist_ok=True)
+  manifest = {
+      'version': MANIFEST_VERSION,
+      'step': int(step),
+      'digest': digest,
+      'time': time.time(),
+      'files': _file_inventory(ckpt_path),
+  }
+  tmp = path + '.tmp'
+  with open(tmp, 'w') as f:
+    json.dump(manifest, f)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+  return path
+
+
+def read_manifest(ckpt_path: str) -> Optional[Dict[str, Any]]:
+  try:
+    with open(manifest_path(ckpt_path)) as f:
+      return json.load(f)
+  except (FileNotFoundError, json.JSONDecodeError):
+    return None
+
+
+def validate_checkpoint(ckpt_path: str) -> Tuple[bool, str]:
+  """(ok, reason). Cheap structural validation: a committed manifest
+  whose step matches the directory name and whose recorded file
+  inventory matches what is on disk (existence + exact sizes — catches
+  truncation without loading any arrays)."""
+  if not os.path.isdir(ckpt_path):
+    return False, 'not a directory'
+  step = checkpoint_step(ckpt_path)
+  if step is None:
+    return False, 'name does not match checkpoint-<step>'
+  manifest = read_manifest(ckpt_path)
+  if manifest is None:
+    return False, 'no committed manifest (save did not finish?)'
+  if manifest.get('version') != MANIFEST_VERSION:
+    return False, f'unknown manifest version {manifest.get("version")!r}'
+  if manifest.get('step') != step:
+    return False, (
+        f'manifest step {manifest.get("step")} != directory step {step}'
+    )
+  recorded = manifest.get('files') or {}
+  if not recorded:
+    return False, 'manifest records no files'
+  for rel, size in recorded.items():
+    full = os.path.join(ckpt_path, rel)
+    if not os.path.exists(full):
+      return False, f'missing file {rel}'
+    actual = os.path.getsize(full)
+    if actual != size:
+      return False, f'size mismatch for {rel}: {actual} != {size}'
+  return True, 'ok'
+
+
+def verify_digest(ckpt_path: str) -> bool:
+  """Deep verification: reload the checkpoint and compare its leaf-tree
+  digest against the manifest's. Expensive (full restore) — forensic
+  use, not the resume path."""
+  manifest = read_manifest(ckpt_path)
+  if manifest is None or not manifest.get('digest'):
+    return False
+  return tree_digest(load_full_state(ckpt_path)) == manifest['digest']
+
+
+def quarantine_checkpoint(ckpt_path: str, reason: str) -> str:
+  """Moves a corrupt/uncommitted checkpoint (and its manifest, if any)
+  into <ckpt_dir>/.quarantine/ so the resume scan never considers it
+  again, preserving the bytes for forensics. Returns the new path."""
+  ckpt_path = ckpt_path.rstrip(os.sep)
+  qdir = os.path.join(os.path.dirname(ckpt_path), QUARANTINE_DIRNAME)
+  os.makedirs(qdir, exist_ok=True)
+  dest = os.path.join(qdir, os.path.basename(ckpt_path))
+  suffix = 0
+  while os.path.exists(dest):
+    suffix += 1
+    dest = os.path.join(qdir, f'{os.path.basename(ckpt_path)}.{suffix}')
+  os.rename(ckpt_path, dest)
+  src_manifest = manifest_path(ckpt_path)
+  if os.path.exists(src_manifest):
+    os.rename(src_manifest, dest + '.manifest.json')
+  with open(dest + '.reason.txt', 'w') as f:
+    f.write(reason + '\n')
+  log.warning('quarantined checkpoint %s -> %s (%s)',
+              ckpt_path, dest, reason)
+  return dest
+
+
+def _candidate_steps(ckpt_dir: str) -> List[Tuple[int, str]]:
+  """(step, path) for checkpoint-N subdirectories, newest first."""
+  if not os.path.isdir(ckpt_dir):
+    return []
+  out = []
+  for name in os.listdir(ckpt_dir):
+    m = _CKPT_NAME_RE.match(name)
+    path = os.path.join(ckpt_dir, name)
+    if m and os.path.isdir(path):
+      out.append((int(m.group(1)), path))
+  return sorted(out, reverse=True)
+
+
+def latest_valid_checkpoint(ckpt_dir: str,
+                            quarantine: bool = True) -> Optional[str]:
+  """Newest checkpoint that passes validation; invalid newer ones are
+  quarantined (or just skipped with quarantine=False — e.g. on
+  non-primary hosts, where process 0 owns the shared filesystem
+  mutation) so training falls back instead of crash-looping on a
+  half-written resume source.
+
+  Legacy compatibility: a checkpoint directory predating the manifest
+  format (no .manifests/ entry for ANY candidate) is handled with the
+  old newest-step-wins rule rather than quarantining a whole run's
+  history."""
+  candidates = _candidate_steps(ckpt_dir)
+  if not candidates:
+    return None
+  if not any(read_manifest(path) is not None for _, path in candidates):
+    newest = candidates[0][1]
+    log.warning(
+        'checkpoint dir %s has no manifests (written by an older '
+        'version?); falling back to newest-step resume: %s',
+        ckpt_dir, newest,
+    )
+    return newest
+  for _, path in candidates:
+    ok, reason = validate_checkpoint(path)
+    if ok:
+      return path
+    if quarantine:
+      try:
+        quarantine_checkpoint(path, reason)
+      except OSError as e:  # racing host already moved it
+        log.warning('could not quarantine %s: %s', path, e)
+    else:
+      log.warning('skipping invalid checkpoint %s (%s)', path, reason)
+  return None
+
+
+def latest_valid_step(ckpt_dir: str) -> Optional[int]:
+  """Step of the newest valid checkpoint, without quarantining
+  (read-only — used by the crash-loop breaker to detect stalled
+  restarts)."""
+  candidates = _candidate_steps(ckpt_dir)
+  if candidates and not any(
+      read_manifest(path) is not None for _, path in candidates):
+    return candidates[0][0]
+  for step, path in candidates:
+    if validate_checkpoint(path)[0]:
+      return step
+  return None

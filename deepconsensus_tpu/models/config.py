@@ -229,6 +229,11 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.vocab_size = 5
   params.seed = 1
   params.remove_label_gaps = False
+  # Use the shard-interleaved StreamingDataset for training input
+  # instead of the eager in-memory DatasetIterator. Requires
+  # n_examples_train to size the per-epoch step budget
+  # (--set streaming=true --set n_examples_train=N).
+  params.streaming = False
   # Streaming-loader decode processes (0 = in-process decode). Each
   # worker sustains ~10k ex/s (gzip + minimal proto parse, measured
   # per-core); size to the mesh's consumption rate on multi-core hosts.
@@ -287,6 +292,21 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.best_checkpoint_metric = 'eval/per_example_accuracy'
 
   params.tpu_scale_factor = 1
+
+  # Training fault tolerance (models/train.py, models/data.py).
+  # on_shard_error: StreamingDataset policy for an undecodable shard —
+  # 'fail' aborts, 'skip' counts + moves on (--on_shard_error).
+  params.on_shard_error = 'fail'
+  # NaN/Inf sentinel: after this many CONSECUTIVE non-finite train
+  # steps, roll back to the last valid checkpoint (0 disables).
+  params.nan_sentinel_steps = 3
+  # Rollback budget; divergence persisting past it raises a permanent
+  # NonFiniteTrainingError instead of ping-ponging forever.
+  params.nan_max_rollbacks = 2
+  # Decode window ids ('name') into training batches so NaN dead
+  # letters can attribute a diverged batch to its windows (small
+  # decode cost; off by default).
+  params.track_window_ids = False
 
   if config_name is None:
     return params

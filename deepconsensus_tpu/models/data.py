@@ -7,7 +7,9 @@ lightweight shuffling loader that feeds jax.device_put directly.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
+import logging
 from typing import Dict, Iterator, List, Sequence, Union
 
 import ml_collections
@@ -18,6 +20,17 @@ from deepconsensus_tpu.io.example_proto import Example
 from deepconsensus_tpu.io.tfrecord import read_tfrecords
 from deepconsensus_tpu.preprocess.pileup import layout_from_shape, row_indices
 from deepconsensus_tpu.utils import phred
+
+log = logging.getLogger(__name__)
+
+
+class OnShardError:
+  """--on_shard_error policy values (StreamingDataset)."""
+
+  FAIL = 'fail'
+  SKIP = 'skip'
+
+  CHOICES = (FAIL, SKIP)
 
 
 def format_rows(
@@ -102,20 +115,30 @@ _MINIMAL_FIELDS = frozenset({
 })
 
 
+_MINIMAL_FIELDS_WITH_NAME = _MINIMAL_FIELDS | {'name'}
+
+
 def parse_example_minimal(
-    raw: bytes, inference: bool = False
+    raw: bytes, inference: bool = False, with_name: bool = False
 ) -> Dict[str, np.ndarray]:
   """Training/eval fast path: decodes only the subreads tensor (raw,
   unformatted) and the label. Row formatting and label gap-shifting
   are deferred to the batch level (format_rows_batch /
   phred.left_shift), which is ~4x cheaper per example than the
-  per-example path (measured on the bundled train shard)."""
-  ex = Example.parse(raw, fields=_MINIMAL_FIELDS)
+  per-example path (measured on the bundled train shard).
+
+  with_name additionally decodes the window id ('name'), so the NaN
+  sentinel's dead letters can attribute a diverged batch to its
+  windows (params.track_window_ids)."""
+  fields = _MINIMAL_FIELDS_WITH_NAME if with_name else _MINIMAL_FIELDS
+  ex = Example.parse(raw, fields=fields)
   out = {
       'subreads': np.frombuffer(
           ex['subreads/encoded'][0], dtype=constants.NP_DATA_TYPE
       ).reshape(ex['subreads/shape'])
   }
+  if with_name and 'name' in ex:
+    out['name'] = ex['name'][0]
   if not inference:
     out['label'] = np.frombuffer(
         ex['label/encoded'][0], dtype=constants.NP_DATA_TYPE
@@ -124,11 +147,17 @@ def parse_example_minimal(
 
 
 def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
-                       chunk: int = 64) -> None:
+                       chunk: int = 64, on_shard_error: str = 'fail',
+                       with_name: bool = False) -> None:
   """StreamingDataset worker: reads its shard subset forever (gzip +
   framing + minimal parse all inside this process) and ships parsed
-  chunks to the parent. Terminated by the parent; blocking put keeps
-  it idle when the consumer falls behind."""
+  chunks to the parent as ('chunk', parses) tuples. A shard that fails
+  to decode under on_shard_error='skip' is reported as a
+  ('shard_error', description) tuple and the worker moves on; under
+  'fail' the worker exits nonzero and the parent's liveness check
+  raises. Terminated by the parent; blocking put keeps it idle when
+  the consumer falls behind."""
+  from deepconsensus_tpu import faults as faults_lib
   from deepconsensus_tpu.io.tfrecord import TFRecordReader
 
   rng = np.random.default_rng(seed)
@@ -137,12 +166,29 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
     # One shard at a time (native whole-shard decode: memory per worker
     # is bounded by its largest shard); the parent's reservoir buffer
     # plus this per-epoch permutation provide the mixing.
+    produced = False
     for i in rng.permutation(len(paths)):
-      for raw in TFRecordReader(paths[i], native_decode=True):
-        pending.append(parse_example_minimal(raw, inference))
-        if len(pending) >= chunk:
-          out_queue.put(pending)
-          pending = []
+      path = paths[i]
+      faults_lib.maybe_kill_shard_reader(path)
+      try:
+        for raw in TFRecordReader(path, native_decode=True):
+          pending.append(parse_example_minimal(raw, inference, with_name))
+          produced = True
+          if len(pending) >= chunk:
+            out_queue.put(('chunk', pending))
+            pending = []
+      except Exception as e:  # noqa: BLE001 - policy-gated
+        if on_shard_error != OnShardError.SKIP:
+          raise
+        # Records decoded before the fault are good parses; keep them.
+        out_queue.put(
+            ('shard_error', f'{path}: {type(e).__name__}: {e}')
+        )
+    if not produced and on_shard_error == OnShardError.SKIP:
+      raise RuntimeError(
+          f'every shard failed to decode under on_shard_error=skip: '
+          f'{paths}'
+      )
 
 
 def _batch_from_minimal(
@@ -156,6 +202,8 @@ def _batch_from_minimal(
           np.stack([c['subreads'] for c in chosen]), params
       )
   }
+  if 'name' in chosen[0]:
+    batch['name'] = np.asarray([c['name'] for c in chosen], dtype=object)
   if not inference:
     label = np.stack([c['label'] for c in chosen])
     if params.remove_label_gaps:
@@ -203,17 +251,19 @@ class DatasetIterator:
   limit: int = -1
 
   def __post_init__(self):
+    with_name = bool(self.params.get('track_window_ids', False))
     minimal: List[Dict[str, np.ndarray]] = []
     for i, raw in enumerate(read_tfrecords(self.patterns)):
       if 0 <= self.limit <= i:
         break
-      minimal.append(parse_example_minimal(raw, self.inference))
+      minimal.append(parse_example_minimal(raw, self.inference, with_name))
     if not minimal:
       raise ValueError(f'no examples matched {self.patterns!r}')
     batch = _batch_from_minimal(minimal, self.params, self.inference)
     minimal.clear()
     self.rows = batch['rows']
     self.labels = batch.get('label')
+    self.names = batch.get('name')
     self._rng = np.random.default_rng(self.seed)
 
   def __len__(self) -> int:
@@ -236,6 +286,8 @@ class DatasetIterator:
     for start in range(0, stop, self.batch_size):
       idx = order[start : start + self.batch_size]
       batch = {'rows': self.rows[idx]}
+      if self.names is not None:
+        batch['name'] = self.names[idx]
       if self.labels is not None:
         batch['label'] = self.labels[idx]
       yield batch
@@ -266,14 +318,27 @@ class StreamingDataset:
   # dp>=8 training (~12k ex/s/host) needs either workers on a
   # many-core host or per-host input sharding (docs/training.md).
   workers: int = 0
+  # 'fail' (default): a shard that fails to decode aborts training.
+  # 'skip': log + count it and move on to the next shard — a single
+  # corrupt shard out of thousands must not kill a multi-day run.
+  on_shard_error: str = OnShardError.FAIL
 
   def __post_init__(self):
     from deepconsensus_tpu.io.tfrecord import glob_paths
 
+    if self.on_shard_error not in OnShardError.CHOICES:
+      raise ValueError(
+          f'on_shard_error must be one of {OnShardError.CHOICES}, '
+          f'got {self.on_shard_error!r}'
+      )
     self._paths = glob_paths(self.patterns)
     if not self._paths:
       raise ValueError(f'no shards matched {self.patterns!r}')
     self._rng = np.random.default_rng(self.seed)
+    self._with_name = bool(self.params.get('track_window_ids', False))
+    # Fault counters (n_shard_errors, ...) survive the iterator so the
+    # training driver can report them at end of run.
+    self.counters: collections.Counter = collections.Counter()
 
   def _raw_stream(self) -> Iterator[bytes]:
     """Shards in a fresh random order each epoch, consumed ONE AT A
@@ -286,8 +351,26 @@ class StreamingDataset:
     from deepconsensus_tpu.io.tfrecord import TFRecordReader
 
     while True:
+      produced = False
       for i in self._rng.permutation(len(self._paths)):
-        yield from TFRecordReader(self._paths[i], native_decode=True)
+        path = self._paths[i]
+        try:
+          for raw in TFRecordReader(path, native_decode=True):
+            produced = True
+            yield raw
+        except Exception as e:  # noqa: BLE001 - policy-gated below
+          if self.on_shard_error != OnShardError.SKIP:
+            raise
+          self.counters['n_shard_errors'] += 1
+          log.warning('on_shard_error=skip: skipping shard %s (%s: %s)',
+                      path, type(e).__name__, e)
+      if not produced:
+        # All shards bad: without this the skip policy would spin
+        # forever yielding nothing while the consumer waits.
+        raise RuntimeError(
+            f'every shard failed to decode under on_shard_error=skip: '
+            f'{self._paths}'
+        )
 
   def _minimal_stream(self, stop) -> Iterator[Dict[str, np.ndarray]]:
     """Raw records -> minimal parses, optionally via worker processes.
@@ -302,7 +385,7 @@ class StreamingDataset:
       for raw in self._raw_stream():
         if stop.is_set():
           return
-        yield parse_example_minimal(raw, self.inference)
+        yield parse_example_minimal(raw, self.inference, self._with_name)
       return
     import multiprocessing
     import queue as queue_lib
@@ -316,11 +399,12 @@ class StreamingDataset:
     ctx = multiprocessing.get_context('spawn')
     out_queue = ctx.Queue(maxsize=64)  # of <=64-parse chunks (~2 MB each)
     procs = []
+    worker_paths = [self._paths[w::n_workers] for w in range(n_workers)]
     for w in range(n_workers):
-      paths = self._paths[w::n_workers]
       proc = ctx.Process(
           target=_shard_reader_main,
-          args=(paths, self.inference, self.seed + w, out_queue),
+          args=(worker_paths[w], self.inference, self.seed + w, out_queue,
+                64, self.on_shard_error, self._with_name),
           daemon=True,
       )
       proc.start()
@@ -339,10 +423,18 @@ class StreamingDataset:
           if not p.is_alive() and p.exitcode not in (0, None)
       ]
       if crashed:
+        # Name the dead workers' shard subsets: 'worker 1 crashed' is
+        # undebuggable, 'worker 1 owned these 3 files' points straight
+        # at the corrupt shard.
+        detail = '; '.join(
+            f'worker {w} (exit code {code}) owned shards '
+            f'{worker_paths[w]}'
+            for w, code in crashed
+        )
         raise RuntimeError(
-            f'StreamingDataset worker(s) crashed: {crashed} of '
-            f'{n_workers}; check shard paths/integrity (corrupt shard '
-            f'or OOM)'
+            f'StreamingDataset worker(s) crashed ({len(crashed)} of '
+            f'{n_workers}): {detail}; check shard paths/integrity '
+            f'(corrupt shard or OOM)'
         )
       if not any(p.is_alive() for p in procs):
         codes = [p.exitcode for p in procs]
@@ -355,10 +447,15 @@ class StreamingDataset:
       while not stop.is_set():
         check_liveness()
         try:
-          chunk = out_queue.get(timeout=5)
+          kind, payload = out_queue.get(timeout=5)
         except queue_lib.Empty:
           continue
-        yield from chunk
+        if kind == 'shard_error':
+          self.counters['n_shard_errors'] += 1
+          log.warning('on_shard_error=skip: worker skipped shard (%s)',
+                      payload)
+          continue
+        yield from payload
     finally:
       for proc in procs:
         proc.terminate()

@@ -9,10 +9,13 @@ checkpoint_metrics.tsv sidecar, and crash-resumable state.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 import json
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -26,6 +29,8 @@ from flax.training import train_state as ts_lib
 import orbax.checkpoint as ocp
 
 from deepconsensus_tpu import constants
+from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu.models import checkpoints as checkpoints_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import data as data_lib
 from deepconsensus_tpu.models import losses as losses_lib
@@ -249,6 +254,10 @@ class Trainer:
       )
       metrics = {
           'loss': loss,
+          # Exposed for the NaN/Inf sentinel: a non-finite gradient can
+          # poison the params even when this step's loss still computes
+          # finite, so divergence is judged on both.
+          'grad_norm': optax.global_norm(grads),
           'accuracy_correct': correct,
           'accuracy_total': total,
       }
@@ -342,6 +351,9 @@ class Trainer:
     batches = 0
     yield_metric = metrics_lib.YieldOverCCS()
     for batch in eval_ds.epoch():
+      # Window ids (params.track_window_ids) are training-loop
+      # forensics; the jitted eval step shards (rows, label) only.
+      batch = {k: v for k, v in batch.items() if k != 'name'}
       batch = self.globalize_batch(batch)
       out = {k: float(v) for k, v in eval_step(state, batch).items()}
       yield_metric.update(out['identity_ccs'], out['identity_pred'])
@@ -371,25 +383,34 @@ class Trainer:
   def save_checkpoint(self, state: TrainState, step: int,
                       eval_metrics: Dict[str, float]) -> str:
     path = os.path.join(self._ckpt_dir, f'checkpoint-{step}')
+    saved = {
+        'params': jax.device_get(state.params),
+        'opt_state': jax.device_get(state.opt_state),
+        'model_state': jax.device_get(state.model_state),
+        'step': step,
+    }
     # Multi-host: EVERY process calls save — orbax's multihost protocol
     # barriers across processes and writes from the primary only.
-    self._checkpointer.save(
-        path,
-        {
-            'params': jax.device_get(state.params),
-            'opt_state': jax.device_get(state.opt_state),
-            'model_state': jax.device_get(state.model_state),
-            'step': step,
-        },
-        force=True,
-    )
+    self._checkpointer.save(path, saved, force=True)
     # Block until the async write finalizes so a crash right after this
     # point never leaves a half-written latest checkpoint.
     wait = getattr(self._checkpointer, 'wait_until_finished', None)
     if wait is not None:
       wait()
     if jax.process_index() != 0:
-      # Metric sidecars (TSV, best-checkpoint) have one writer.
+      # Metric sidecars (TSV, best-checkpoint) and manifests have one
+      # writer.
+      return path
+    # Commit the integrity manifest only now that the checkpoint is
+    # fully on disk: its presence marks the directory as complete, and
+    # its file inventory lets latest_valid_checkpoint detect truncation
+    # without loading arrays.
+    checkpoints_lib.write_manifest(
+        path, step, digest=checkpoints_lib.tree_digest(saved)
+    )
+    if not eval_metrics:
+      # Emergency (preemption) saves carry no eval pass; skip the
+      # metric sidecars rather than writing an empty TSV header.
       return path
     header_needed = not os.path.exists(self._metrics_tsv)
     if header_needed:
@@ -452,19 +473,19 @@ class Trainer:
         step=jnp.asarray(restored['step']),
     )
 
-  def latest_checkpoint(self) -> Optional[str]:
-    if not os.path.isdir(self._ckpt_dir):
-      return None
-    steps = []
-    for name in os.listdir(self._ckpt_dir):
-      if name.startswith('checkpoint-'):
-        try:
-          steps.append(int(name.split('-')[1]))
-        except ValueError:
-          continue
-    if not steps:
-      return None
-    return os.path.join(self._ckpt_dir, f'checkpoint-{max(steps)}')
+  def latest_valid_checkpoint(self) -> Optional[str]:
+    """Newest checkpoint that passes integrity validation; corrupt or
+    uncommitted (manifest-less) directories are quarantined to
+    checkpoints/.quarantine/ and the scan falls back to the next
+    valid one. Replaces the old latest_checkpoint(), which compared
+    step numbers only and would happily resume onto a half-written
+    directory."""
+    return checkpoints_lib.latest_valid_checkpoint(
+        self._ckpt_dir, quarantine=jax.process_index() == 0
+    )
+
+  # Backward-compatible name; validation semantics included.
+  latest_checkpoint = latest_valid_checkpoint
 
   def log_metrics(self, step: int, split: str, metrics: Dict[str, float]):
     if jax.process_index() != 0:
@@ -501,6 +522,162 @@ class Trainer:
         except (TypeError, ValueError):
           continue
       writer.flush()
+
+
+class PreemptionGuard:
+  """SIGTERM/SIGINT -> emergency checkpoint at the next step boundary.
+
+  TPU-VM preemption delivers SIGTERM with a short grace period; a
+  Ctrl-C during a long local run deserves the same treatment. The
+  handler only sets a flag — the training loop polls requested() once
+  per step and performs the (collective) checkpoint save itself, so the
+  save never runs inside a signal handler or mid-step. A second signal
+  aborts immediately (raises KeyboardInterrupt) for operators who
+  really mean it.
+
+  Multi-host: the decision to stop must be unanimous — the orbax save
+  is collective, so one host checkpointing alone would deadlock the
+  rest. requested() allgathers the local flags and trips when ANY host
+  saw a signal.
+  """
+
+  def __init__(self):
+    self._event = threading.Event()
+    self._prev: Dict[int, Any] = {}
+    self.signum: Optional[int] = None
+
+  def install(self) -> 'PreemptionGuard':
+    import signal
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+      try:
+        self._prev[sig] = signal.signal(sig, self._handle)
+      except ValueError:
+        # Not the main thread (e.g. training driven from a worker
+        # thread in tests): preemption safety degrades to the default
+        # handlers rather than breaking training.
+        pass
+    return self
+
+  def _handle(self, signum, frame):
+    del frame
+    if self._event.is_set():
+      raise KeyboardInterrupt(
+          f'second signal {signum} during checkpoint-and-exit'
+      )
+    self.signum = signum
+    self._event.set()
+    logging.getLogger(__name__).warning(
+        'signal %s received; will checkpoint and exit at the next step '
+        'boundary (send again to abort immediately)', signum,
+    )
+
+  def requested(self) -> bool:
+    local = self._event.is_set()
+    if jax.process_count() == 1:
+      return local
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([local], dtype=np.int32)
+    )
+    return bool(np.any(flags))
+
+  def restore(self) -> None:
+    import signal
+
+    for sig, prev in self._prev.items():
+      try:
+        signal.signal(sig, prev)
+      except ValueError:
+        pass
+    self._prev = {}
+
+
+class NanSentinel:
+  """Watches per-step loss/grad-norm finiteness; after `limit`
+  consecutive non-finite steps, rolls training back to the last valid
+  checkpoint (the train step donates and overwrites its input state, so
+  a NaN update poisons the live params irreversibly — rollback is the
+  only recovery). Every non-finite step is dead-lettered with the
+  offending batch's window ids (params.track_window_ids) or a content
+  fingerprint, in the PR 1 sidecar format, to <out_dir>/training.failed.jsonl.
+
+  Verdicts are read one step late: float(metrics) blocks on the device,
+  so checking step k while step k+1 is dispatching preserves the
+  async-dispatch pipeline. The one extra contaminated step costs
+  nothing — rollback discards it either way. The exception is a save
+  boundary (eval checkpoint, emergency preemption save, final save):
+  there the loop force-resolves the pending verdict and refuses to
+  checkpoint while `consecutive > 0`, so a poisoned state can never
+  become the "last valid checkpoint" the rollback restores.
+  """
+
+  def __init__(self, params: ml_collections.ConfigDict, out_dir: str):
+    self.limit = int(params.get('nan_sentinel_steps', 3) or 0)
+    self.max_rollbacks = int(params.get('nan_max_rollbacks', 2) or 0)
+    self.enabled = self.limit > 0
+    self.consecutive = 0
+    self.rollbacks = 0
+    self.counters: collections.Counter = collections.Counter()
+    self._dead_letter = None
+    if self.enabled and jax.process_index() == 0:
+      self._dead_letter = faults_lib.DeadLetterWriter(
+          os.path.join(out_dir, 'training.failed.jsonl'), append=True
+      )
+
+  def observe(self, step: int, metrics: Dict[str, Any],
+              names, batch: Optional[Dict[str, np.ndarray]]) -> bool:
+    """Returns True (and records a dead letter) when this step's loss
+    or grad norm is non-finite."""
+    loss = float(metrics['loss'])
+    grad_norm = float(metrics.get('grad_norm', 0.0))
+    if np.isfinite(loss) and np.isfinite(grad_norm):
+      self.consecutive = 0
+      return False
+    self.consecutive += 1
+    self.counters['n_nonfinite_steps'] += 1
+    extra: Dict[str, Any] = {
+        'step': step, 'loss': loss, 'grad_norm': grad_norm,
+    }
+    if names is not None:
+      extra['window_ids'] = [
+          n.decode('utf-8', 'replace') if isinstance(n, bytes) else str(n)
+          for n in names
+      ]
+    elif batch is not None and 'rows' in batch:
+      extra['batch_sha1'] = hashlib.sha1(
+          np.ascontiguousarray(batch['rows']).tobytes()
+      ).hexdigest()[:16]
+    will_roll = self.consecutive >= self.limit
+    if self._dead_letter is not None:
+      self._dead_letter.record(
+          None, 'train', faults_lib.FaultKind.TRANSIENT,
+          f'non-finite training step: loss={loss} grad_norm={grad_norm}',
+          'rollback' if will_roll else 'recorded', extra=extra,
+      )
+    logging.getLogger(__name__).warning(
+        'non-finite training step %d (loss=%s grad_norm=%s; %d/%d '
+        'consecutive)', step, loss, grad_norm, self.consecutive,
+        self.limit,
+    )
+    return True
+
+  def should_rollback(self) -> bool:
+    return self.enabled and self.consecutive >= self.limit
+
+  def rolled_back(self, checkpoint: str) -> None:
+    self.rollbacks += 1
+    self.consecutive = 0
+    self.counters['n_nan_rollbacks'] += 1
+    logging.getLogger(__name__).warning(
+        'NaN sentinel: rolled back to %s (rollback %d/%d)',
+        checkpoint, self.rollbacks, self.max_rollbacks,
+    )
+
+  def close(self) -> None:
+    if self._dead_letter is not None:
+      self._dead_letter.close()
 
 
 def run_training(
@@ -564,12 +741,13 @@ def run_training(
   trainer = Trainer(params=params, out_dir=out_dir, mesh=mesh)
   config_lib.save_params_as_json(out_dir, params)
   state = trainer.init_state(steps_total=decay_steps)
-  if warm_start and trainer.latest_checkpoint() is not None:
+  resume_from = trainer.latest_valid_checkpoint()
+  if warm_start and resume_from is not None:
     logging.getLogger(__name__).warning(
         'warm_start=%s ignored: %s already has checkpoints; resuming '
         'from the latest instead', warm_start, out_dir,
     )
-  if warm_start and trainer.latest_checkpoint() is None:
+  if warm_start and resume_from is None:
     # Warm start adopts weights only; optimizer starts fresh
     # (reference --checkpoint warm start: model_train_custom_loop.py:119-124).
     # Applies only to the very first start: once this run has its own
@@ -582,36 +760,43 @@ def run_training(
   def run_eval(state) -> Dict[str, float]:
     return trainer.run_eval(state, eval_ds)
 
-  # Crash-resume: pick up from the newest checkpoint in out_dir
-  # (reference resumable training: model_utils.py:511-540).
+  # Crash-resume: pick up from the newest VALID checkpoint in out_dir
+  # (reference resumable training: model_utils.py:511-540) — a
+  # half-written or truncated latest checkpoint is quarantined by
+  # latest_valid_checkpoint and the previous one wins.
   # The out_dir's own latest checkpoint always wins over warm_start:
   # warm_start seeds only the very first start, so a preempted
   # warm-started run resumes its own progress instead of resetting.
   step = 0
-  latest = trainer.latest_checkpoint()
-  if latest:
-    state = trainer.restore_checkpoint(state, latest)
+  if resume_from:
+    state = trainer.restore_checkpoint(state, resume_from)
     step = int(state.step)
 
   profile_dir = params.get('profile_dir', None)
   if profile_dir:
     jax.profiler.start_trace(profile_dir)
 
+  stream_ds = None
+  if streaming:
+    # Constructed here (after checkpoint restore) so the stream can be
+    # reseeded by resume position: a restarted run draws fresh
+    # (differently-shuffled) data instead of replaying the head of the
+    # corpus. Held in a variable so its fault counters (skipped shards
+    # etc.) survive the iterator for the end-of-run summary.
+    stream_ds = data_lib.StreamingDataset(
+        patterns=train_patterns,
+        params=params,
+        batch_size=params.batch_size,
+        **({'buffer_size': params.buffer_size}
+           if 'buffer_size' in params else {}),
+        workers=params.get('loader_workers', 0),
+        seed=params.seed + step,
+        on_shard_error=params.get('on_shard_error', 'fail'),
+    )
+
   def train_batches():
     if streaming:
-      # Fold the resume step into the stream seed so a restarted run
-      # draws fresh (differently-shuffled) data instead of replaying
-      # the head of the corpus.
-      ds = data_lib.StreamingDataset(
-          patterns=train_patterns,
-          params=params,
-          batch_size=params.batch_size,
-          **({'buffer_size': params.buffer_size}
-             if 'buffer_size' in params else {}),
-          workers=params.get('loader_workers', 0),
-          seed=params.seed + step,
-      )
-      it = iter(ds)
+      it = iter(stream_ds)
       try:
         for _ in range(max(steps_per_epoch * num_epochs - step, 0)):
           yield next(it)
@@ -640,6 +825,36 @@ def run_training(
         for b in train_batches()
     )
 
+  guard = PreemptionGuard().install()
+  sentinel = NanSentinel(params, out_dir)
+  # The sentinel reads verdicts one step late (see NanSentinel);
+  # pending holds (step, metrics, window ids, host batch) for the step
+  # whose device result is not yet known.
+  pending = None
+
+  def rollback():
+    nonlocal state, step, pending
+    if sentinel.rollbacks >= sentinel.max_rollbacks:
+      raise faults_lib.NonFiniteTrainingError(
+          f'training diverged: non-finite steps persisted through '
+          f'{sentinel.rollbacks} rollback(s); refusing to roll back '
+          f'again (params.nan_max_rollbacks={sentinel.max_rollbacks})'
+      )
+    latest = trainer.latest_valid_checkpoint()
+    if latest is None:
+      raise faults_lib.NonFiniteTrainingError(
+          f'training diverged after {sentinel.consecutive} consecutive '
+          f'non-finite step(s) at step {step} and no valid checkpoint '
+          f'exists to roll back to'
+      )
+    # The contaminated state is still a valid restore template (same
+    # tree/shapes); its values are fully overwritten.
+    state = trainer.restore_checkpoint(state, latest)
+    step = int(state.step)
+    pending = None
+    sentinel.rolled_back(latest)
+
+  preempted = False
   final_metrics: Dict[str, float] = {}
   try:
     # Background prefetch: host-side decode/shuffle/stacking for batch
@@ -647,10 +862,21 @@ def run_training(
     # before compute finishes). Reference counterpart: tf.data
     # prefetch(AUTOTUNE) in data_providers.py.
     for batch in data_lib.prefetch_iterator(maybe_augmented()):
+      names = batch.pop('name', None)
+      faults_lib.maybe_poison_batch(step + 1, batch)
+      host_batch = batch if sentinel.enabled else None
       batch = trainer.globalize_batch(batch)
       with jax.profiler.StepTraceAnnotation('train', step_num=step):
         state, m = train_step(state, batch)
       step += 1
+      faults_lib.maybe_kill_train_at_step(step)
+      faults_lib.maybe_sigterm_at_step(step)
+      if sentinel.enabled:
+        if pending is not None and sentinel.observe(*pending):
+          if sentinel.should_rollback():
+            rollback()
+            continue
+        pending = (step, m, names, host_batch)
       if step % params.get('log_every_n_steps', 100) == 0:
         m_host = {k: float(v) for k, v in m.items()}
         m_host['train/accuracy'] = m_host['accuracy_correct'] / max(
@@ -658,13 +884,69 @@ def run_training(
         )
         trainer.log_metrics(step, 'train', m_host)
       if step % eval_every == 0:
-        final_metrics = run_eval(state)
-        trainer.log_metrics(step, 'eval', final_metrics)
-        trainer.save_checkpoint(state, step, final_metrics)
-    final_metrics = run_eval(state)
-    trainer.log_metrics(step, 'eval', final_metrics)
-    trainer.save_checkpoint(state, step, final_metrics)
+        # Force-resolve the delayed verdict before checkpointing: a
+        # save boundary crossed while the state is contaminated would
+        # persist NaN params, and the rollback path would then "heal"
+        # onto the poisoned checkpoint. The extra device sync is free
+        # here — eval blocks on the device anyway.
+        if sentinel.enabled and pending is not None:
+          sentinel.observe(*pending)
+          pending = None
+        if sentinel.should_rollback():
+          rollback()
+          continue
+        if sentinel.consecutive:
+          logging.getLogger(__name__).warning(
+              'skipping eval/checkpoint at step %d: state contaminated '
+              'by a non-finite update (%d/%d consecutive)',
+              step, sentinel.consecutive, sentinel.limit,
+          )
+        else:
+          final_metrics = run_eval(state)
+          trainer.log_metrics(step, 'eval', final_metrics)
+          trainer.save_checkpoint(state, step, final_metrics)
+      if guard.requested():
+        # Emergency checkpoint at the step boundary, then a clean
+        # return: the retry wrapper / scheduler restarts from it.
+        # Same contamination guard as above: resuming from a NaN
+        # emergency save would be worse than losing a few steps.
+        if sentinel.enabled and pending is not None:
+          sentinel.observe(*pending)
+          pending = None
+        if sentinel.consecutive:
+          logging.getLogger(__name__).warning(
+              'skipping emergency checkpoint at step %d: state '
+              'contaminated by a non-finite update; resume will fall '
+              'back to the last valid checkpoint', step,
+          )
+        else:
+          trainer.save_checkpoint(state, step, {})
+        final_metrics = {'preempted': 1.0, 'stop_step': float(step)}
+        preempted = True
+        logging.getLogger(__name__).warning(
+            'preemption checkpoint saved at step %d; exiting cleanly',
+            step,
+        )
+        break
+    if not preempted:
+      if sentinel.enabled and pending is not None:
+        sentinel.observe(*pending)
+        pending = None
+      if sentinel.enabled and sentinel.consecutive:
+        # Out of data with contaminated params: roll back even below
+        # the threshold rather than finish (and save) a NaN state.
+        rollback()
+      final_metrics = run_eval(state)
+      trainer.log_metrics(step, 'eval', final_metrics)
+      trainer.save_checkpoint(state, step, final_metrics)
   finally:
+    guard.restore()
+    sentinel.close()
+    fault_counters: Dict[str, float] = dict(sentinel.counters)
+    if stream_ds is not None:
+      fault_counters.update(stream_ds.counters)
+    if fault_counters:
+      trainer.log_metrics(step, 'faults', fault_counters)
     if profile_dir:
       jax.profiler.stop_trace()
   if jax.process_count() > 1:
@@ -677,24 +959,72 @@ def run_training(
   return final_metrics
 
 
-def run_training_with_retry(*args, max_retries: int = 1_000_000, **kwargs):
-  """Retries training on device-unavailable errors (TPU preemption),
-  resuming from the latest checkpoint (reference retry-forever loop:
-  model_train_custom_loop.py:333-347)."""
+_UNSET = object()
+
+
+def run_training_with_retry(
+    *args,
+    max_retries: int = 1_000_000,
+    backoff_base: float = 0.5,
+    backoff_max: float = 60.0,
+    max_stalled_restarts: int = 3,
+    **kwargs,
+):
+  """Retries training on transient failures (TPU preemption,
+  device-unavailable), resuming from the latest valid checkpoint
+  (reference retry-forever loop: model_train_custom_loop.py:333-347) —
+  with three brakes the reference lacks:
+
+  * only TRANSIENT errors retry (shared taxonomy,
+    deepconsensus_tpu/faults.classify_error); a permanent error (bad
+    config, bad data, diverged model) raises on the first attempt
+    instead of looping forever;
+  * exponential backoff between attempts (backoff_base * 2^k, capped
+    at backoff_max) so a flapping device isn't hammered;
+  * a crash-loop breaker: when the resume step fails to advance across
+    max_stalled_restarts consecutive restarts, retrying cannot help
+    (the failure precedes the first new checkpoint every time) and
+    CrashLoopError aborts the loop.
+  """
+  log = logging.getLogger(__name__)
+  out_dir = kwargs.get('out_dir')
+  if out_dir is None and len(args) >= 2 and isinstance(args[1], str):
+    out_dir = args[1]
   attempts = 0
+  last_step = _UNSET
+  stalled = 0
   while True:
     try:
       return run_training(*args, **kwargs)
     except Exception as e:  # pylint: disable=broad-except
-      message = str(e)
-      transient = any(
-          key in message.upper()
-          for key in ('UNAVAILABLE', 'DEADLINE_EXCEEDED', 'PREEMPT')
-      )
+      message = f'{type(e).__name__}: {e}'
       attempts += 1
-      if not transient or attempts > max_retries:
+      if faults_lib.classify_error(message) != faults_lib.FaultKind.TRANSIENT:
         raise
-      logging.getLogger(__name__).warning(
-          'transient device failure (%s); restarting from latest '
-          'checkpoint (attempt %d)', message.splitlines()[0], attempts,
+      if attempts > max_retries:
+        raise
+      if out_dir is not None:
+        # Crash-loop detection needs the resume position; read it
+        # without quarantining (run_training owns that mutation).
+        resume_step = checkpoints_lib.latest_valid_step(
+            os.path.join(os.path.abspath(out_dir), 'checkpoints')
+        )
+        if last_step is not _UNSET and resume_step == last_step:
+          stalled += 1
+          if stalled >= max_stalled_restarts:
+            raise faults_lib.CrashLoopError(
+                f'training failed {stalled + 1} consecutive time(s) '
+                f'without the resume step advancing past '
+                f'{resume_step}; aborting instead of crash-looping '
+                f'(last error: {message.splitlines()[0]})'
+            ) from e
+        else:
+          stalled = 0
+        last_step = resume_step
+      delay = min(backoff_max, backoff_base * (2 ** (attempts - 1)))
+      log.warning(
+          'transient failure (%s); restarting from latest valid '
+          'checkpoint in %.1fs (attempt %d)',
+          message.splitlines()[0], delay, attempts,
       )
+      time.sleep(delay)

@@ -1,7 +1,8 @@
 #!/bin/bash
 # Test runner (reference parity: run_all_tests.sh).
-#   ./run_all_tests.sh          # full suite
-#   ./run_all_tests.sh simple   # quick smoke: parity + inference e2e
+#   ./run_all_tests.sh             # full suite + resilience suite
+#   ./run_all_tests.sh simple      # quick smoke: parity + inference e2e
+#   ./run_all_tests.sh resilience  # fault-injection suite only
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -9,4 +10,12 @@ if [[ "${1:-}" == "simple" ]]; then
   exec python -m pytest \
     tests/test_preprocess_parity.py tests/test_inference_e2e.py -q
 fi
-exec python -m pytest tests/ -q
+
+if [[ "${1:-}" == "resilience" ]]; then
+  exec scripts/run_resilience.sh
+fi
+
+python -m pytest tests/ -q
+# The resilience marker includes slow fault-injection tests (subprocess
+# SIGKILL/resume) that the main invocation deselects.
+exec scripts/run_resilience.sh

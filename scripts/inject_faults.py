@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
-"""Fault-injection harness for the inference fault-tolerance layer.
+"""Fault-injection harness for the fault-tolerance layers.
 
-Three tools, usable from the CLI or imported by tests:
+Inference-side tools:
 
 * synth    — write a synthetic (subreads_to_ccs.bam, ccs.bam) pair with
              deterministic sequences, one BGZF block per ZMW so a
@@ -12,9 +12,18 @@ Three tools, usable from the CLI or imported by tests:
 * truncate — chop a file to a fraction/byte count, producing a
              mid-stream BGZF decode fault (decode-stage).
 
-Worker SIGKILL and consumer-crash injection are driven by env vars read
-by deepconsensus_tpu/inference/faults.py (ENV_KILL_ZMW, ENV_KILL_TOKEN,
-ENV_CRASH_AFTER_BATCHES); this script documents them in --help.
+Training-side tools:
+
+* synth_tfrecords — write synthetic training TFRecord shards (the
+             pileup-tensor + label examples models/data.py consumes),
+             so resilience tests need no reference testdata.
+* corrupt_ckpt — truncate a checkpoint's largest array file (size
+             mismatch vs the integrity manifest) or delete its
+             manifest (simulates a save that never committed).
+
+Worker SIGKILL, NaN-batch, preemption-signal, and consumer-crash
+injection are driven by env vars read by deepconsensus_tpu/faults.py;
+this script documents them in --help.
 """
 from __future__ import annotations
 
@@ -159,18 +168,117 @@ def truncate_file(path: str, fraction: float = 0.5,
   return keep
 
 
+def write_synthetic_tfrecords(
+    out_dir: str,
+    n_shards: int = 2,
+    n_examples: int = 64,
+    max_passes: int = 5,
+    max_length: int = 20,
+    seed: int = 3,
+    compression: str = 'BGZF',
+) -> List[str]:
+  """Writes synthetic training shards shard-NNNNN.tfrecord.gz.
+
+  Examples carry the fields models/data.py parses (subreads tensor of
+  shape (4*max_passes+5, max_length, 1) for use_ccs_bq=False, label of
+  shape (max_length,), plus name/num_passes/window_pos/quality for the
+  full parse path). Content is drawn so training is well-posed: bases,
+  ccs, and label agree per column, so a tiny model reaches a finite,
+  decreasing loss. Examples are spread round-robin over n_shards.
+  Returns the shard paths.
+  """
+  from deepconsensus_tpu.io.example_proto import Example
+  from deepconsensus_tpu.io.tfrecord import TFRecordWriter
+
+  rng = np.random.RandomState(seed)
+  os.makedirs(out_dir, exist_ok=True)
+  total_rows = 4 * max_passes + 5
+  paths = [
+      os.path.join(out_dir, f'shard-{i:05d}.tfrecord.gz')
+      for i in range(n_shards)
+  ]
+  writers = [TFRecordWriter(p, compression=compression) for p in paths]
+  for i in range(n_examples):
+    seq = rng.randint(1, 5, size=max_length)  # vocab ' ATCG' -> 1..4
+    subreads = np.zeros((total_rows, max_length, 1), dtype=np.float32)
+    for p in range(max_passes):
+      subreads[p, :, 0] = seq                      # bases
+      subreads[max_passes + p, :, 0] = rng.randint(1, 5, max_length)  # pw
+      subreads[2 * max_passes + p, :, 0] = rng.randint(1, 9, max_length)
+      subreads[3 * max_passes + p, :, 0] = 1 + (p % 2)  # strand
+    subreads[4 * max_passes, :, 0] = seq             # ccs row
+    subreads[4 * max_passes + 1:, :, 0] = rng.uniform(
+        4.0, 12.0, size=(4, 1)
+    )                                                # sn rows
+    label = seq.astype(np.float32)
+    ex = Example()
+    ex.add_bytes('subreads/encoded',
+                 [subreads.astype(np.float32).tobytes()])
+    ex.add_int64('subreads/shape', list(subreads.shape))
+    ex.add_bytes('label/encoded', [label.tobytes()])
+    ex.add_int64('label/shape', [max_length])
+    ex.add_bytes('name', [f'syn/{100 + i}/ccs-{i}'.encode('ascii')])
+    ex.add_int64('subreads/num_passes', [max_passes])
+    ex.add_int64('window_pos', [i * max_length])
+    ex.add_int64('ccs_base_quality_scores', [30] * max_length)
+    writers[i % n_shards].write(ex.serialize())
+  for w in writers:
+    w.close()
+  return paths
+
+
+def corrupt_checkpoint(ckpt_path: str, mode: str = 'truncate',
+                       fraction: float = 0.5) -> str:
+  """Corrupts one orbax checkpoint directory. Returns the path acted on.
+
+  * truncate: chops the largest file under the directory — the
+    integrity manifest's size inventory then disagrees, so
+    latest_valid_checkpoint quarantines the directory.
+  * delete-manifest: removes the committed manifest — indistinguishable
+    from a save that never finished.
+  """
+  from deepconsensus_tpu.models import checkpoints as ckpt_lib
+
+  if mode == 'delete-manifest':
+    manifest = ckpt_lib.manifest_path(ckpt_path)
+    os.unlink(manifest)
+    return manifest
+  if mode != 'truncate':
+    raise ValueError(f'unknown corrupt_checkpoint mode {mode!r}')
+  largest, largest_size = None, -1
+  for root, _, files in os.walk(ckpt_path):
+    for name in files:
+      full = os.path.join(root, name)
+      size = os.path.getsize(full)
+      if size > largest_size:
+        largest, largest_size = full, size
+  if largest is None:
+    raise FileNotFoundError(f'no files under {ckpt_path!r}')
+  truncate_file(largest, fraction=fraction)
+  return largest
+
+
 def main(argv: Optional[List[str]] = None) -> int:
   parser = argparse.ArgumentParser(
       description=__doc__,
       formatter_class=argparse.RawDescriptionHelpFormatter,
       epilog=(
-          'Env-var hooks (read by inference/faults.py):\n'
+          'Env-var hooks (read by deepconsensus_tpu/faults.py):\n'
           '  DCTPU_FAULT_KILL_ZMW=<ccs name>   SIGKILL the pool worker '
           'featurizing that ZMW\n'
           '  DCTPU_FAULT_KILL_TOKEN=<path>     kill only once (token '
           'file created on first kill)\n'
           '  DCTPU_FAULT_CRASH_AFTER_BATCHES=N crash the consumer loop '
           'after N batches\n'
+          '  DCTPU_FAULT_NAN_AT_STEP=N         poison the training batch '
+          'consumed at step N with NaNs (fires once per process)\n'
+          '  DCTPU_FAULT_SIGTERM_AT_STEP=N     deliver SIGTERM to the '
+          'trainer after step N (preemption drill, fires once)\n'
+          '  DCTPU_FAULT_KILL_TRAIN_AT_STEP=N  SIGKILL the trainer after '
+          'step N (token-gated: fires once across restarts)\n'
+          '  DCTPU_FAULT_KILL_SHARD_READER=<substr>  SIGKILL the shard '
+          'reader that opens a shard path containing substr '
+          '(token-gated)\n'
       ),
   )
   sub = parser.add_subparsers(dest='command', required=True)
@@ -196,6 +304,23 @@ def main(argv: Optional[List[str]] = None) -> int:
   p.add_argument('--fraction', type=float, default=0.5)
   p.add_argument('--bytes', type=int, default=None, dest='keep_bytes')
 
+  p = sub.add_parser('synth_tfrecords',
+                     help='Write synthetic training TFRecord shards.')
+  p.add_argument('--out_dir', required=True)
+  p.add_argument('--n_shards', type=int, default=2)
+  p.add_argument('--n_examples', type=int, default=64)
+  p.add_argument('--max_passes', type=int, default=5)
+  p.add_argument('--max_length', type=int, default=20)
+  p.add_argument('--seed', type=int, default=3)
+
+  p = sub.add_parser('corrupt_ckpt',
+                     help='Truncate or un-commit a checkpoint directory.')
+  p.add_argument('--ckpt', required=True,
+                 help='Path to one checkpoint-N directory.')
+  p.add_argument('--mode', choices=('truncate', 'delete-manifest'),
+                 default='truncate')
+  p.add_argument('--fraction', type=float, default=0.5)
+
   args = parser.parse_args(argv)
   if args.command == 'synth':
     subreads, ccs = write_synthetic_zmw_bams(
@@ -214,6 +339,17 @@ def main(argv: Optional[List[str]] = None) -> int:
   if args.command == 'truncate':
     print(truncate_file(args.path, fraction=args.fraction,
                         keep_bytes=args.keep_bytes))
+    return 0
+  if args.command == 'synth_tfrecords':
+    for path in write_synthetic_tfrecords(
+        args.out_dir, n_shards=args.n_shards, n_examples=args.n_examples,
+        max_passes=args.max_passes, max_length=args.max_length,
+        seed=args.seed):
+      print(path)
+    return 0
+  if args.command == 'corrupt_ckpt':
+    print(corrupt_checkpoint(args.ckpt, mode=args.mode,
+                             fraction=args.fraction))
     return 0
   return 2
 

@@ -1,14 +1,18 @@
 #!/usr/bin/env bash
 # Runs the fault-injection (resilience) test suite on CPU.
 #
-# These tests exercise the inference fault-tolerance layer — per-ZMW
-# quarantine, CCS fallback, the pool watchdog (real SIGKILLs), and
-# crash/resume — against synthetic BAMs, so they need no reference
-# testdata and no accelerator. The timeout keeps the suite inside the
-# tier-1 budget; the whole run takes well under a minute on a laptop.
+# These tests exercise both fault-tolerance layers — inference (per-ZMW
+# quarantine, CCS fallback, the pool watchdog's real SIGKILLs,
+# crash/resume) and training (checkpoint integrity manifests +
+# quarantine, preemption-safe SIGTERM saves, the NaN sentinel's
+# rollback, corrupt-shard skip, the crash-loop breaker, and a real
+# SIGKILL + truncated-checkpoint restart) — against synthetic BAMs and
+# TFRecord shards, so they need no reference testdata and no
+# accelerator. The timeout keeps the suite inside the tier-1 budget;
+# the whole run takes a couple of minutes on a laptop.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-timeout -k 10 300 env JAX_PLATFORMS=cpu \
+timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python -m pytest tests/ -q -m resilience \
   --continue-on-collection-errors "$@"

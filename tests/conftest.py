@@ -33,8 +33,8 @@ REFERENCE_TESTDATA = pathlib.Path('/root/reference/deepconsensus/testdata')
 def pytest_configure(config):
   config.addinivalue_line(
       'markers',
-      'resilience: fault-injection tests for the inference '
-      'fault-tolerance layer (scripts/run_resilience.sh)',
+      'resilience: fault-injection tests for the inference and '
+      'training fault-tolerance layers (scripts/run_resilience.sh)',
   )
 
 
