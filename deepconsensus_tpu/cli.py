@@ -140,6 +140,31 @@ def _add_run(sub):
                  help='Pad out each featurize batch\'s model tail '
                  'instead of packing windows across batches into full '
                  'fixed-shape model batches (debug/compat).')
+  p.add_argument('--max_record_bytes', type=int, default=64 << 20,
+                 help='Per-record allocation cap for the BAM decoders: '
+                 'a record claiming more than this many bytes is '
+                 'treated as corrupt (quarantined under '
+                 '--on_zmw_error=skip) instead of allocated.')
+
+
+def _add_validate(sub):
+  p = sub.add_parser(
+      'validate',
+      help='Preflight-check inputs before spending TPU time on them.')
+  p.add_argument('--subreads_to_ccs', default=None,
+                 help='actc output BAM (subreads aligned to ccs).')
+  p.add_argument('--ccs_bam', default=None,
+                 help='ccs BAM; with --subreads_to_ccs also checks '
+                 'name/order consistency between the pair.')
+  p.add_argument('--tfrecord', action='append', default=[],
+                 metavar='GLOB',
+                 help='TFRecord path or glob (repeatable); every '
+                 'matching shard is CRC-checked end to end.')
+  p.add_argument('--max_record_bytes', type=int, default=None,
+                 help='Per-record allocation cap (default 64 MiB).')
+  p.add_argument('--report', default=None,
+                 help='Also write the JSON report to this path '
+                 '(always printed to stdout).')
 
 
 def _add_train(sub):
@@ -281,6 +306,7 @@ def build_parser() -> argparse.ArgumentParser:
   sub = parser.add_subparsers(dest='command', required=True)
   _add_preprocess(sub)
   _add_run(sub)
+  _add_validate(sub)
   _add_train(sub)
   _add_distill(sub)
   _add_export(sub)
@@ -330,6 +356,29 @@ def _dispatch(args) -> int:
     )
     return 0
 
+  if args.command == 'validate':
+    import json
+
+    from deepconsensus_tpu.io import validate as validate_lib
+
+    if (args.subreads_to_ccs is None and args.ccs_bam is None
+        and not args.tfrecord):
+      raise ValueError(
+          'validate needs at least one of --subreads_to_ccs, '
+          '--ccs_bam, --tfrecord')
+    report = validate_lib.validate_inputs(
+        subreads_to_ccs=args.subreads_to_ccs,
+        ccs_bam=args.ccs_bam,
+        tfrecords=args.tfrecord,
+        max_record_bytes=args.max_record_bytes,
+    )
+    text = json.dumps(report, indent=2)
+    print(text)
+    if args.report:
+      with open(args.report, 'w') as f:
+        f.write(text + '\n')
+    return 0 if report['ok'] else 1
+
   if args.command == 'run':
     from deepconsensus_tpu.calibration import lib as calibration_lib
     from deepconsensus_tpu.inference import runner as runner_lib
@@ -359,6 +408,7 @@ def _dispatch(args) -> int:
         dispatch_depth=args.dispatch_depth,
         emit_queue_depth=args.emit_queue_depth,
         pack_across_batches=not args.no_cross_batch_packing,
+        max_record_bytes=args.max_record_bytes,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal
         ),

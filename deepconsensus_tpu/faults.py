@@ -48,6 +48,47 @@ def classify_error(error_text: str) -> str:
   return FaultKind.PERMANENT
 
 
+class CorruptInputError(IOError):
+  """Untrusted input bytes failed decode-layer validation.
+
+  The single typed error every hardened decoder (io/bam.py,
+  io/tfrecord.py, io/fastx.py, the native ctypes wrappers) raises in
+  place of bare struct.error / ValueError / MemoryError when a length,
+  count, magic, or CRC field in the input cannot be trusted. Carries
+  machine-readable context so the fault policies and `dctpu validate`
+  can report file + byte offset + ZMW without parsing the message:
+
+  * path:   the input file
+  * offset: byte offset of the bad frame (decompressed-stream offset
+            for BGZF-compressed inputs, raw file offset otherwise)
+  * zmw:    per-molecule context when known (read name / ZMW)
+  * recoverable: True when the stream is positioned past the damaged
+            record so the caller may keep reading (record-local body
+            corruption inside intact framing); False when the stream
+            cannot be advanced (bad framing, truncation, compression
+            errors).
+
+  Permanent by construction: the message carries no transient markers,
+  so retry loops re-raise instead of re-reading bad bytes.
+  """
+
+  def __init__(self, message: str, *, path: Optional[str] = None,
+               offset: Optional[int] = None, zmw: Optional[str] = None,
+               recoverable: bool = False):
+    context = [
+        f'file={path}' if path else None,
+        f'offset={offset}' if offset is not None else None,
+        f'zmw={zmw}' if zmw else None,
+    ]
+    context = [c for c in context if c]
+    super().__init__(
+        f'{message} [{" ".join(context)}]' if context else message)
+    self.path = path
+    self.offset = offset
+    self.zmw = zmw
+    self.recoverable = recoverable
+
+
 class CrashLoopError(RuntimeError):
   """Raised by run_training_with_retry when restarts stop making
   progress: the same resume step across K consecutive transient

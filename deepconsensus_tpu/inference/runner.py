@@ -97,6 +97,10 @@ class InferenceOptions:
   # 'skip' drops the ZMW (dead-lettered), 'ccs-fallback' emits the
   # draft CCS read with its original base qualities instead.
   on_zmw_error: str = 'fail'  # fail | skip | ccs-fallback
+  # Per-record allocation cap for the hardened BAM decoders
+  # (io/bam.py): a record claiming more than this is treated as
+  # corrupt — quarantined under on_zmw_error=skip — never allocated.
+  max_record_bytes: int = 64 << 20
   # >0: per-batch watchdog timeout (s) on the featurization pool; a
   # hung/SIGKILLed worker surfaces as a timeout, triggering pool
   # re-spawn + bounded retry (batch_retries) before quarantine.
@@ -924,6 +928,7 @@ def run_inference(
       shard=options.shard,
       quarantine=quarantine,
       resume_skip_groups=resume_skip_groups,
+      max_record_bytes=options.max_record_bytes,
   )
   watchdog: Optional[faults.PoolWatchdog] = None
   if (options.cpus and options.cpus > 1
@@ -957,7 +962,8 @@ def run_inference(
     # CCS BAM is in play (ccs_fasta mode).
     header_text = '@HD\tVN:1.5\tSO:unknown\n'
     if ccs_bam:
-      with bam_lib.BamReader(ccs_bam) as ccs_reader:
+      with bam_lib.BamReader(
+          ccs_bam, max_record_bytes=options.max_record_bytes) as ccs_reader:
         if ccs_reader.header_text:
           header_text = ccs_reader.header_text
           if not header_text.endswith('\n'):

@@ -1,8 +1,26 @@
-"""Minimal FASTA/FASTQ reading and FASTQ writing (gzip-aware)."""
+"""Minimal FASTA/FASTQ reading and FASTQ writing (gzip-aware).
+
+Hardened against untrusted input: structural violations (a FASTQ record
+whose separator line is not '+', mismatched sequence/quality lengths,
+EOF mid-record, lines beyond a per-record cap, undecodable bytes,
+corrupt gzip streams) raise the typed
+``deepconsensus_tpu.faults.CorruptInputError`` naming file and line
+instead of silently mis-parsing or leaking a codec/zlib error.
+"""
 from __future__ import annotations
 
 import gzip
+import zlib
 from typing import Dict, Iterator, Tuple
+
+from deepconsensus_tpu.faults import CorruptInputError
+
+# Longest single line accepted (sequence/quality lines; a CCS read is a
+# few hundred KiB). readline() is capped at this so a corrupt stream
+# with no newlines cannot buffer unbounded bytes.
+MAX_LINE_BYTES = 64 << 20
+
+_DECOMPRESS_ERRORS = (EOFError, gzip.BadGzipFile, zlib.error)
 
 
 def _open(path: str, mode: str = 'rt'):
@@ -11,20 +29,54 @@ def _open(path: str, mode: str = 'rt'):
   return open(path, mode)
 
 
+def _readline(f, path: str, lineno: int) -> str:
+  """Bounded, error-wrapped readline: decompression/codec failures and
+  over-long lines raise CorruptInputError naming file + line."""
+  try:
+    line = f.readline(MAX_LINE_BYTES)
+  except _DECOMPRESS_ERRORS as e:
+    raise CorruptInputError(
+        f'compressed stream corrupt or truncated at line {lineno} '
+        f'({type(e).__name__}: {e})', path=path, offset=lineno) from e
+  except (UnicodeDecodeError, ValueError) as e:
+    raise CorruptInputError(
+        f'undecodable text at line {lineno} ({e})',
+        path=path, offset=lineno) from e
+  if len(line) >= MAX_LINE_BYTES and not line.endswith('\n'):
+    raise CorruptInputError(
+        f'line {lineno} exceeds {MAX_LINE_BYTES} bytes',
+        path=path, offset=lineno)
+  return line
+
+
 def read_fasta(path: str) -> Dict[str, str]:
   """Loads a FASTA file into {name: sequence}."""
   seqs: Dict[str, str] = {}
   name = None
   parts = []
+  lineno = 0
   with _open(path) as f:
-    for line in f:
+    while True:
+      lineno += 1
+      line = _readline(f, path, lineno)
+      if not line:
+        break
       line = line.rstrip('\n')
       if line.startswith('>'):
         if name is not None:
           seqs[name] = ''.join(parts)
-        name = line[1:].split()[0]
+        fields = line[1:].split()
+        if not fields:
+          raise CorruptInputError(
+              f'FASTA header with no name at line {lineno}',
+              path=path, offset=lineno)
+        name = fields[0]
         parts = []
       else:
+        if name is None and line:
+          raise CorruptInputError(
+              f'FASTA sequence data before any header at line {lineno}',
+              path=path, offset=lineno)
         parts.append(line)
   if name is not None:
     seqs[name] = ''.join(parts)
@@ -34,13 +86,34 @@ def read_fasta(path: str) -> Dict[str, str]:
 def read_fastq(path: str) -> Iterator[Tuple[str, str, str]]:
   """Yields (name, sequence, quality_string)."""
   with _open(path) as f:
+    lineno = 0
     while True:
-      header = f.readline()
+      header = _readline(f, path, lineno + 1)
       if not header:
         return
-      seq = f.readline().rstrip('\n')
-      f.readline()  # '+'
-      qual = f.readline().rstrip('\n')
+      seq = _readline(f, path, lineno + 2)
+      plus = _readline(f, path, lineno + 3)
+      qual = _readline(f, path, lineno + 4)
+      if not header.startswith('@'):
+        raise CorruptInputError(
+            f'FASTQ record header at line {lineno + 1} does not start '
+            f'with "@"', path=path, offset=lineno + 1)
+      if not qual:
+        raise CorruptInputError(
+            f'truncated FASTQ record starting at line {lineno + 1} '
+            f'(stream ended mid-record)', path=path, offset=lineno + 1)
+      if not plus.startswith('+'):
+        raise CorruptInputError(
+            f'FASTQ separator at line {lineno + 3} is not "+"',
+            path=path, offset=lineno + 3)
+      seq = seq.rstrip('\n')
+      qual = qual.rstrip('\n')
+      if len(seq) != len(qual):
+        raise CorruptInputError(
+            f'FASTQ record at line {lineno + 1} has sequence length '
+            f'{len(seq)} but quality length {len(qual)}',
+            path=path, offset=lineno + 1)
+      lineno += 4
       yield header.rstrip('\n')[1:], seq, qual
 
 

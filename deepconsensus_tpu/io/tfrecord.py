@@ -11,7 +11,21 @@ from __future__ import annotations
 import glob as globlib
 import gzip
 import struct
+import zlib
 from typing import Iterable, Iterator, List, Optional, Union
+
+from deepconsensus_tpu.faults import CorruptInputError
+
+# Per-record allocation cap: the length field of a TFRecord frame is
+# untrusted until its CRC verifies, and even a CRC-valid length must
+# stay under this bound before the payload is allocated. A window
+# example in this pipeline is ~100 KiB; 64 MiB is two-plus orders of
+# magnitude of headroom.
+DEFAULT_MAX_RECORD_BYTES = 64 << 20
+
+# Exceptions the gzip/zlib machinery can raise mid-stream on corrupt or
+# truncated compressed input.
+_DECOMPRESS_ERRORS = (EOFError, gzip.BadGzipFile, zlib.error)
 
 # ---------------------------------------------------------------------------
 # crc32c (Castagnoli), table-driven.
@@ -172,7 +186,8 @@ class TFRecordReader:
 
   def __init__(self, path: str, compression: Optional[str] = None,
                check_crc: bool = False, native_decode: bool = False,
-               native_threads: int = 4):
+               native_threads: int = 4,
+               max_record_bytes: int = DEFAULT_MAX_RECORD_BYTES):
     if compression is None and path.endswith('.gz'):
       compression = 'GZIP'
     import os
@@ -185,6 +200,7 @@ class TFRecordReader:
     self._f = None  # streaming handle, opened lazily on first use
     self._consumed = False
     self._check_crc = check_crc
+    self._max_record_bytes = int(max_record_bytes)
 
   def _native_records(self) -> Optional[List[bytes]]:
     try:
@@ -224,24 +240,50 @@ class TFRecordReader:
     if self._f is None:
       self._f = (gzip.open(self._path, 'rb') if self._compressed
                  else open(self._path, 'rb'))
-    read = self._f.read
+
+    def checked_read(n: int, what: str, offset: int) -> bytes:
+      try:
+        return self._f.read(n)
+      except _DECOMPRESS_ERRORS as e:
+        raise CorruptInputError(
+            f'compressed TFRecord stream corrupt or truncated reading '
+            f'{what} ({type(e).__name__}: {e})',
+            path=self._path, offset=offset) from e
+
+    offset = 0  # decompressed-stream offset of the current frame
     while True:
-      header = read(8)
+      header = checked_read(8, 'length header', offset)
       if not header:
         return
       if len(header) != 8:
-        raise IOError('truncated TFRecord length header')
+        raise CorruptInputError(
+            'truncated TFRecord length header',
+            path=self._path, offset=offset)
       (length,) = struct.unpack('<Q', header)
-      len_crc = read(4)
-      data = read(length)
-      data_crc = read(4)
+      len_crc = checked_read(4, 'length crc', offset)
+      if len(len_crc) != 4:
+        raise CorruptInputError(
+            'truncated TFRecord length crc', path=self._path, offset=offset)
+      # The length field is untrusted until its CRC verifies; check it
+      # unconditionally (not just under check_crc) BEFORE allocating
+      # `length` bytes — a corrupt header must not OOM the host.
+      if struct.unpack('<I', len_crc)[0] != _masked_crc(header):
+        raise CorruptInputError(
+            'TFRecord length crc mismatch', path=self._path, offset=offset)
+      if length > self._max_record_bytes:
+        raise CorruptInputError(
+            f'TFRecord length {length} exceeds max_record_bytes '
+            f'{self._max_record_bytes}', path=self._path, offset=offset)
+      data = checked_read(length, 'payload', offset)
+      data_crc = checked_read(4, 'payload crc', offset)
       if len(data) != length or len(data_crc) != 4:
-        raise IOError('truncated TFRecord payload')
+        raise CorruptInputError(
+            'truncated TFRecord payload', path=self._path, offset=offset)
       if self._check_crc:
-        if struct.unpack('<I', len_crc)[0] != _masked_crc(header):
-          raise IOError('TFRecord length crc mismatch')
         if struct.unpack('<I', data_crc)[0] != _masked_crc(data):
-          raise IOError('TFRecord data crc mismatch')
+          raise CorruptInputError(
+              'TFRecord data crc mismatch', path=self._path, offset=offset)
+      offset += 8 + 4 + length + 4
       yield data
 
   def close(self) -> None:

@@ -16,6 +16,7 @@ import ml_collections
 import numpy as np
 
 from deepconsensus_tpu import constants
+from deepconsensus_tpu.faults import CorruptInputError
 from deepconsensus_tpu.io.example_proto import Example
 from deepconsensus_tpu.io.tfrecord import read_tfrecords
 from deepconsensus_tpu.preprocess.pileup import layout_from_shape, row_indices
@@ -172,7 +173,16 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
       faults_lib.maybe_kill_shard_reader(path)
       try:
         for raw in TFRecordReader(path, native_decode=True):
-          pending.append(parse_example_minimal(raw, inference, with_name))
+          try:
+            parsed = parse_example_minimal(raw, inference, with_name)
+          except Exception as e:  # noqa: BLE001 - policy-gated
+            if on_shard_error != OnShardError.SKIP:
+              raise
+            # Record-local payload corruption (see the serial path).
+            out_queue.put(
+                ('corrupt_record', f'{path}: {type(e).__name__}: {e}'))
+            continue
+          pending.append(parsed)
           produced = True
           if len(pending) >= chunk:
             out_queue.put(('chunk', pending))
@@ -181,8 +191,12 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
         if on_shard_error != OnShardError.SKIP:
           raise
         # Records decoded before the fault are good parses; keep them.
+        # The corrupt flag lets the parent count decode-layer
+        # corruption (n_corrupt_records) separately from other shard
+        # failures in the faults metrics split.
         out_queue.put(
-            ('shard_error', f'{path}: {type(e).__name__}: {e}')
+            ('shard_error', (f'{path}: {type(e).__name__}: {e}',
+                             isinstance(e, faults_lib.CorruptInputError)))
         )
     if not produced and on_shard_error == OnShardError.SKIP:
       raise RuntimeError(
@@ -362,6 +376,8 @@ class StreamingDataset:
           if self.on_shard_error != OnShardError.SKIP:
             raise
           self.counters['n_shard_errors'] += 1
+          if isinstance(e, CorruptInputError):
+            self.counters['n_corrupt_records'] += 1
           log.warning('on_shard_error=skip: skipping shard %s (%s: %s)',
                       path, type(e).__name__, e)
       if not produced:
@@ -385,7 +401,21 @@ class StreamingDataset:
       for raw in self._raw_stream():
         if stop.is_set():
           return
-        yield parse_example_minimal(raw, self.inference, self._with_name)
+        try:
+          parsed = parse_example_minimal(raw, self.inference,
+                                         self._with_name)
+        except Exception as e:  # noqa: BLE001 - policy-gated
+          if self.on_shard_error != OnShardError.SKIP:
+            raise
+          # Frame-intact but undecodable payload: the streaming loader
+          # skips payload CRCs for speed, so bit rot inside a record
+          # surfaces here at proto-parse time. Record-local — skip just
+          # this record, not the shard.
+          self.counters['n_corrupt_records'] += 1
+          log.warning('on_shard_error=skip: undecodable record '
+                      '(%s: %s)', type(e).__name__, e)
+          continue
+        yield parsed
       return
     import multiprocessing
     import queue as queue_lib
@@ -451,8 +481,16 @@ class StreamingDataset:
         except queue_lib.Empty:
           continue
         if kind == 'shard_error':
+          message, corrupt = payload
           self.counters['n_shard_errors'] += 1
+          if corrupt:
+            self.counters['n_corrupt_records'] += 1
           log.warning('on_shard_error=skip: worker skipped shard (%s)',
+                      message)
+          continue
+        if kind == 'corrupt_record':
+          self.counters['n_corrupt_records'] += 1
+          log.warning('on_shard_error=skip: worker skipped record (%s)',
                       payload)
           continue
         yield from payload

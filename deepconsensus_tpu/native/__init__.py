@@ -72,6 +72,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ctypes.c_int,
         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
         ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_size_t,
     ]
     lib.dc_free.argtypes = [ctypes.c_void_p]
     lib.dc_crc32c.restype = ctypes.c_uint32
@@ -106,15 +107,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
-def bgzf_decompress_file(path: str, n_threads: int = 4) -> Optional[bytes]:
-  """Decompresses a whole BGZF file in parallel; None -> use fallback."""
+def bgzf_decompress_file(path: str, n_threads: int = 4,
+                         max_out: int = 0) -> Optional[bytes]:
+  """Decompresses a whole BGZF file in parallel; None -> use fallback.
+
+  max_out (0 = unlimited) bounds the decompressed size: the BGZF block
+  scan knows the total before inflating anything, so an oversized (or
+  length-field-inflated) file returns None without allocating."""
   lib = get_lib()
   if lib is None:
     return None
   out = ctypes.POINTER(ctypes.c_uint8)()
   out_len = ctypes.c_size_t()
   rc = lib.dc_bgzf_decompress_file(
-      path.encode(), n_threads, ctypes.byref(out), ctypes.byref(out_len)
+      path.encode(), n_threads, ctypes.byref(out), ctypes.byref(out_len),
+      max_out
   )
   if rc != 0:
     return None

@@ -25,6 +25,7 @@ struct Block {
   size_t in_size;     // compressed payload size (without header/footer)
   size_t out_offset;  // offset in the output buffer
   size_t out_size;    // isize from the gzip footer
+  uint32_t crc;       // crc32 from the gzip footer
 };
 
 // Parses BGZF block boundaries. Returns false on malformed input.
@@ -34,8 +35,11 @@ bool scan_blocks(const uint8_t* data, size_t len, std::vector<Block>* blocks,
   size_t out = 0;
   while (pos + 18 <= len) {
     if (data[pos] != 0x1f || data[pos + 1] != 0x8b) return false;
-    const uint8_t flg = data[pos + 3];
-    if (!(flg & 4)) return false;  // BGZF requires FEXTRA
+    // BGZF fixes CM=8 (deflate) and FLG=4 (FEXTRA only).  Any other
+    // FLG bits change the gzip member layout, which the pure-Python
+    // fallback would parse differently — reject rather than diverge.
+    if (data[pos + 2] != 8) return false;
+    if (data[pos + 3] != 4) return false;
     const uint16_t xlen = data[pos + 10] | (data[pos + 11] << 8);
     size_t extra = pos + 12;
     size_t extra_end = extra + xlen;
@@ -55,9 +59,12 @@ bool scan_blocks(const uint8_t* data, size_t len, std::vector<Block>* blocks,
     const size_t block_end = pos + bsize;
     if (block_end > len || block_end < payload + 8) return false;
     const uint8_t* footer = data + block_end - 8;
+    const uint32_t crc = footer[0] | (footer[1] << 8) | (footer[2] << 16) |
+                         ((uint32_t)footer[3] << 24);
     const uint32_t isize = footer[4] | (footer[5] << 8) | (footer[6] << 16) |
                            ((uint32_t)footer[7] << 24);
-    blocks->push_back(Block{payload, block_end - 8 - payload, out, isize});
+    blocks->push_back(
+        Block{payload, block_end - 8 - payload, out, isize, crc});
     out += isize;
     pos = block_end;
   }
@@ -66,7 +73,7 @@ bool scan_blocks(const uint8_t* data, size_t len, std::vector<Block>* blocks,
 }
 
 bool inflate_block(const uint8_t* src, size_t src_len, uint8_t* dst,
-                   size_t dst_len) {
+                   size_t dst_len, uint32_t expected_crc) {
   z_stream zs;
   memset(&zs, 0, sizeof(zs));
   if (inflateInit2(&zs, -15) != Z_OK) return false;
@@ -76,7 +83,11 @@ bool inflate_block(const uint8_t* src, size_t src_len, uint8_t* dst,
   zs.avail_out = (uInt)dst_len;
   const int ret = inflate(&zs, Z_FINISH);
   inflateEnd(&zs);
-  return ret == Z_STREAM_END && zs.total_out == dst_len;
+  if (ret != Z_STREAM_END || zs.total_out != dst_len) return false;
+  // Raw-deflate mode (-15) skips zlib's own gzip footer handling, so
+  // verify the member CRC here — Python's gzip module does, and the
+  // native path must never accept bytes the fallback would reject.
+  return crc32(crc32(0L, Z_NULL, 0), dst, (uInt)dst_len) == expected_crc;
 }
 
 }  // namespace
@@ -105,9 +116,11 @@ int dc_bgzf_decompress(const uint8_t* data, size_t len, int n_threads,
       const size_t i = next.fetch_add(1);
       if (i >= blocks.size() || failed.load(std::memory_order_relaxed)) break;
       const Block& b = blocks[i];
-      if (b.out_size == 0) continue;
+      // Zero-output blocks (the BGZF EOF marker) still carry a deflate
+      // payload and CRC footer; inflate them too so footer corruption
+      // is rejected exactly like the pure-Python gzip path does.
       if (!inflate_block(data + b.in_offset, b.in_size,
-                         buffer + b.out_offset, b.out_size)) {
+                         buffer + b.out_offset, b.out_size, b.crc)) {
         failed.store(true, std::memory_order_relaxed);
       }
     }
@@ -126,9 +139,10 @@ int dc_bgzf_decompress(const uint8_t* data, size_t len, int n_threads,
   return 0;
 }
 
-// File-path convenience wrapper.
+// File-path convenience wrapper. max_out as in dc_bgzf_decompress
+// (0 = unlimited; oversized output rejects with rc 6 before inflating).
 int dc_bgzf_decompress_file(const char* path, int n_threads, uint8_t** out,
-                            size_t* out_len) {
+                            size_t* out_len, size_t max_out) {
   FILE* f = fopen(path, "rb");
   if (!f) return 10;
   fseek(f, 0, SEEK_END);
@@ -149,7 +163,8 @@ int dc_bgzf_decompress_file(const char* path, int n_threads, uint8_t** out,
     free(data);
     return 13;
   }
-  const int rc = dc_bgzf_decompress(data, size, n_threads, out, out_len, 0);
+  const int rc =
+      dc_bgzf_decompress(data, size, n_threads, out, out_len, max_out);
   free(data);
   return rc;
 }
@@ -233,11 +248,22 @@ int dc_gzip_decompress(const uint8_t* data, size_t len, uint8_t** out,
   return 0;
 }
 
+uint32_t dc_crc32c(const uint8_t* data, size_t len, uint32_t seed);
+
+// TFRecord masked crc (crc32c rotated + constant), as used by the
+// length and payload checksums.
+static uint32_t dc_masked_crc(const uint8_t* data, size_t len) {
+  const uint32_t crc = dc_crc32c(data, len, 0);
+  return (uint32_t)(((crc >> 15) | (crc << 17)) + 0xA282EAD8u);
+}
+
 // Parses TFRecord framing (u64 length, u32 len-crc, payload, u32
 // payload-crc) over a decompressed buffer. Emits (offset, length)
 // pairs of the PAYLOADS into a malloc'd u64 array (caller frees via
-// dc_free). CRCs are not validated (matching the Python reader's
-// check_crc=False default); framing errors return nonzero.
+// dc_free). The length crc IS validated before the length is trusted
+// (matching the hardened Python reader); payload crcs are not
+// (matching the Python reader's check_crc=False default). Framing
+// errors return nonzero.
 int dc_tfrecord_index(const uint8_t* data, size_t len, uint64_t** pairs,
                       size_t* n_records) {
   size_t cap = 1024;
@@ -252,6 +278,12 @@ int dc_tfrecord_index(const uint8_t* data, size_t len, uint64_t** pairs,
     }
     uint64_t rec_len;
     memcpy(&rec_len, data + pos, 8);  // little-endian hosts only (x86/ARM)
+    uint32_t len_crc;
+    memcpy(&len_crc, data + pos + 8, 4);
+    if (len_crc != dc_masked_crc(data + pos, 8)) {
+      free(out);
+      return 1;  // corrupt length header
+    }
     const size_t payload = pos + 12;
     if (rec_len > len || payload + rec_len + 4 > len) {
       free(out);

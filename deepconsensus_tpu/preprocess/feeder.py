@@ -125,6 +125,7 @@ def create_proc_feeder(
     shard: Optional[Tuple[int, int]] = None,
     quarantine=None,
     resume_skip_groups: int = 0,
+    max_record_bytes: int = bam.DEFAULT_MAX_RECORD_BYTES,
 ):
   """Returns (generator_fn, counter) yielding per-ZMW work items.
 
@@ -143,9 +144,15 @@ def create_proc_feeder(
   --resume path replaying the feeder past already-committed ZMWs.
   """
   main_counter: Counter = Counter()
-  grouper = bam.SubreadGrouper(subreads_to_ccs)
+  # Under a quarantine policy the grouper turns recoverable corrupt
+  # records into in-stream CorruptInputError events (handled below)
+  # instead of raising; fail-fast runs keep the historical raise.
+  grouper = bam.SubreadGrouper(subreads_to_ccs,
+                               max_record_bytes=max_record_bytes,
+                               skip_corrupt_records=quarantine is not None)
   if ccs_bam:
-    ccs_iter = iter(bam.BamReader(ccs_bam))
+    ccs_iter = iter(bam.BamReader(ccs_bam,
+                                  max_record_bytes=max_record_bytes))
   elif ccs_fasta:
     ccs_iter = _fasta_ccs_iter(ccs_fasta)
   else:
@@ -178,6 +185,18 @@ def create_proc_feeder(
             'decode', e, fallback=None,
         )
         break
+      if isinstance(read_set, bam.CorruptInputError):
+        # Recoverable corrupt record: the grouper dropped the affected
+        # molecule and kept streaming. Quarantine it (degrades to skip:
+        # ccs-fallback would need a trustworthy name to scan the ccs
+        # stream for, which a corrupt record cannot provide).
+        main_counter['n_corrupt_records'] += 1
+        quarantine.handle(
+            read_set.zmw or (f'<record after {last_name}>'
+                             if last_name else '<record>'),
+            'decode', read_set, fallback=None,
+        )
+        continue
       main_counter['n_zmw_processed'] += 1
       if main_counter['n_zmw_processed'] <= resume_skip_groups:
         main_counter['n_zmw_resume_skipped'] += 1

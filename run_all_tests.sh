@@ -3,6 +3,8 @@
 #   ./run_all_tests.sh             # full suite + resilience suite
 #   ./run_all_tests.sh simple      # quick smoke: parity + inference e2e
 #   ./run_all_tests.sh resilience  # fault-injection suite only
+#   ./run_all_tests.sh io-fuzz     # corruption-fuzz harness only (deep
+#                                  # sweep, 2000 mutants per format)
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -13,6 +15,10 @@ fi
 
 if [[ "${1:-}" == "resilience" ]]; then
   exec scripts/run_resilience.sh
+fi
+
+if [[ "${1:-}" == "io-fuzz" ]]; then
+  exec scripts/run_resilience.sh --io-fuzz
 fi
 
 python -m pytest tests/ -q

@@ -11,6 +11,15 @@ Inference-side tools:
              raise for exactly that molecule (a featurize-stage fault).
 * truncate — chop a file to a fraction/byte count, producing a
              mid-stream BGZF decode fault (decode-stage).
+* fuzz     — deterministic mutational fuzzer: bit flips, truncations,
+             length-field inflation, CRC/zero-run corruption over a
+             seed file, one mutant file per (seed, index). Drives
+             tests/test_io_fuzz.py's decode-layer invariant.
+* corrupt_record — surgically corrupt ONE record of a BAM at the
+             uncompressed layer (l_read_name, cigar count, block_size)
+             and re-BGZF it: record-body modes leave the framing
+             intact, so the hardened reader quarantines exactly that
+             molecule and keeps going.
 
 Training-side tools:
 
@@ -227,6 +236,126 @@ def write_synthetic_tfrecords(
   return paths
 
 
+# ----------------------------------------------------------------------
+# Mutational fuzzing (tests/test_io_fuzz.py)
+
+FUZZ_MODES = ('bitflip', 'truncate', 'length_inflate', 'crc_corrupt',
+              'zero_run')
+
+
+def fuzz_mutants(src: bytes, n_mutants: int, seed: int = 0,
+                 protect_prefix: int = 0,
+                 modes: Sequence[str] = FUZZ_MODES):
+  """Yields (index, mode, mutated_bytes) — deterministic in (seed, src).
+
+  Mutation classes mirror how real inputs rot: random bit flips
+  (storage/transfer), tail truncation (interrupted upload), inflated
+  little-endian length fields (the classic resource-exhaustion vector),
+  footer-area byte smashes (CRC corruption), and zero runs (sparse-file
+  holes). protect_prefix shields the first N bytes so corpora can keep
+  e.g. a magic number intact and exercise deeper parse layers.
+  """
+  rng = np.random.RandomState(seed)
+  n = len(src)
+  if n < 2 or protect_prefix >= n - 1:
+    raise ValueError('source corpus too small to fuzz')
+  lo = protect_prefix
+  for i in range(n_mutants):
+    mode = modes[rng.randint(len(modes))]
+    buf = bytearray(src)
+    if mode == 'bitflip':
+      for _ in range(rng.randint(1, 9)):
+        buf[rng.randint(lo, n)] ^= 1 << rng.randint(8)
+    elif mode == 'truncate':
+      buf = buf[:rng.randint(lo + 1, n)]
+    elif mode == 'length_inflate':
+      pos = rng.randint(lo, max(lo + 1, n - 4))
+      huge = int(rng.choice([1 << 24, 1 << 30, 0x7FFFFFFF, 0xFFFFFFFF]))
+      buf[pos:pos + 4] = huge.to_bytes(4, 'little')
+    elif mode == 'crc_corrupt':
+      # CRCs live near frame/file tails; smash a byte in the last 64.
+      pos = rng.randint(max(lo, n - 64), n)
+      buf[pos] ^= 0xFF
+    elif mode == 'zero_run':
+      pos = rng.randint(lo, n)
+      run = rng.randint(1, min(256, n - pos) + 1)
+      buf[pos:pos + run] = b'\x00' * run
+    else:
+      raise ValueError(f'unknown fuzz mode {mode!r}')
+    yield i, mode, bytes(buf)
+
+
+def write_fuzz_corpus(src_path: str, out_dir: str, n_mutants: int,
+                      seed: int = 0, protect_prefix: int = 0) -> List[str]:
+  """Materializes fuzz_mutants() of one file as mutant-NNNNN-<mode>."""
+  with open(src_path, 'rb') as f:
+    src = f.read()
+  os.makedirs(out_dir, exist_ok=True)
+  paths = []
+  for i, mode, data in fuzz_mutants(src, n_mutants, seed=seed,
+                                    protect_prefix=protect_prefix):
+    path = os.path.join(out_dir, f'mutant-{i:05d}-{mode}')
+    with open(path, 'wb') as f:
+      f.write(data)
+    paths.append(path)
+  return paths
+
+
+BAM_RECORD_MODES = ('read_name_zero', 'read_name_overrun', 'cigar_overrun',
+                    'block_size_inflate')
+
+
+def corrupt_bam_record(in_bam: str, out_bam: str, record_index: int,
+                       mode: str = 'read_name_zero') -> int:
+  """Corrupts exactly one record of a BAM at the uncompressed layer.
+
+  Decompresses the BGZF stream, walks the header + record frames to the
+  record_index'th record, damages it, and re-BGZFs the stream (valid
+  blocks + EOF marker — the compressed container stays pristine, so the
+  damage tests the RECORD decoder, not the gzip layer). Record-body
+  modes (read_name_zero/read_name_overrun/cigar_overrun) keep the
+  block_size framing intact: the hardened reader raises a recoverable
+  CorruptInputError and can keep streaming. block_size_inflate breaks
+  the framing itself (stream-level fault). Returns the decompressed
+  byte offset of the corrupted record.
+  """
+  from deepconsensus_tpu.io.bam_writer import BgzfWriter
+
+  raw = bytearray(bam_lib.bgzf_decompress_file_py(in_bam))
+  if raw[:4] != b'BAM\x01':
+    raise ValueError(f'{in_bam}: not a BAM file')
+  (l_text,) = np.frombuffer(raw[4:8], dtype='<i4')
+  pos = 8 + int(l_text)
+  (n_ref,) = np.frombuffer(raw[pos:pos + 4], dtype='<i4')
+  pos += 4
+  for _ in range(int(n_ref)):
+    (l_name,) = np.frombuffer(raw[pos:pos + 4], dtype='<i4')
+    pos += 4 + int(l_name) + 4
+  index = 0
+  while pos < len(raw):
+    (block_size,) = np.frombuffer(raw[pos:pos + 4], dtype='<i4')
+    if index == record_index:
+      body = pos + 4
+      if mode == 'read_name_zero':
+        raw[body + 8] = 0
+      elif mode == 'read_name_overrun':
+        raw[body + 8] = 0xFF
+      elif mode == 'cigar_overrun':
+        raw[body + 12:body + 14] = (0xFFFF).to_bytes(2, 'little')
+      elif mode == 'block_size_inflate':
+        raw[pos:pos + 4] = (1 << 30).to_bytes(4, 'little')
+      else:
+        raise ValueError(f'unknown corrupt_bam_record mode {mode!r}')
+      writer = BgzfWriter(out_bam)
+      writer.write(bytes(raw))
+      writer.close()
+      return pos
+    pos += 4 + int(block_size)
+    index += 1
+  raise IndexError(
+      f'{in_bam}: record_index {record_index} out of range ({index} records)')
+
+
 def corrupt_checkpoint(ckpt_path: str, mode: str = 'truncate',
                        fraction: float = 0.5) -> str:
   """Corrupts one orbax checkpoint directory. Returns the path acted on.
@@ -304,6 +433,23 @@ def main(argv: Optional[List[str]] = None) -> int:
   p.add_argument('--fraction', type=float, default=0.5)
   p.add_argument('--bytes', type=int, default=None, dest='keep_bytes')
 
+  p = sub.add_parser('fuzz', help='Write a deterministic mutant corpus.')
+  p.add_argument('--src', required=True, help='Seed file to mutate.')
+  p.add_argument('--out_dir', required=True)
+  p.add_argument('--n', type=int, default=100)
+  p.add_argument('--seed', type=int, default=0)
+  p.add_argument('--protect_prefix', type=int, default=0,
+                 help='Shield the first N bytes from mutation.')
+
+  p = sub.add_parser('corrupt_record',
+                     help='Corrupt one BAM record at the uncompressed '
+                     'layer (framing-intact or framing-breaking).')
+  p.add_argument('--in_bam', required=True)
+  p.add_argument('--out_bam', required=True)
+  p.add_argument('--record', type=int, required=True)
+  p.add_argument('--mode', choices=BAM_RECORD_MODES,
+                 default='read_name_zero')
+
   p = sub.add_parser('synth_tfrecords',
                      help='Write synthetic training TFRecord shards.')
   p.add_argument('--out_dir', required=True)
@@ -339,6 +485,16 @@ def main(argv: Optional[List[str]] = None) -> int:
   if args.command == 'truncate':
     print(truncate_file(args.path, fraction=args.fraction,
                         keep_bytes=args.keep_bytes))
+    return 0
+  if args.command == 'fuzz':
+    for path in write_fuzz_corpus(args.src, args.out_dir, args.n,
+                                  seed=args.seed,
+                                  protect_prefix=args.protect_prefix):
+      print(path)
+    return 0
+  if args.command == 'corrupt_record':
+    print(corrupt_bam_record(args.in_bam, args.out_bam, args.record,
+                             mode=args.mode))
     return 0
   if args.command == 'synth_tfrecords':
     for path in write_synthetic_tfrecords(

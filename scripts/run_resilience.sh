@@ -6,12 +6,28 @@
 # crash/resume) and training (checkpoint integrity manifests +
 # quarantine, preemption-safe SIGTERM saves, the NaN sentinel's
 # rollback, corrupt-shard skip, the crash-loop breaker, and a real
-# SIGKILL + truncated-checkpoint restart) — against synthetic BAMs and
-# TFRecord shards, so they need no reference testdata and no
+# SIGKILL + truncated-checkpoint restart) — plus the untrusted-input
+# data plane (bounded BAM/BGZF/TFRecord decoders, `dctpu validate`
+# preflight, and the corruption-fuzz harness) — against synthetic BAMs
+# and TFRecord shards, so they need no reference testdata and no
 # accelerator. The timeout keeps the suite inside the tier-1 budget;
 # the whole run takes a couple of minutes on a laptop.
+#
+#   scripts/run_resilience.sh             # full resilience suite
+#   scripts/run_resilience.sh --io-fuzz   # corruption-fuzz stage only,
+#                                         # at 2000 mutants per format
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--io-fuzz" ]]; then
+  shift
+  # A deeper sweep of just the decoder fuzz + native-parity tests.
+  # DCTPU_FUZZ_MUTANTS scales every fuzz loop (default 500 in-suite).
+  exec timeout -k 10 1200 env JAX_PLATFORMS=cpu \
+    DCTPU_FUZZ_MUTANTS="${DCTPU_FUZZ_MUTANTS:-2000}" \
+    python -m pytest tests/test_io_fuzz.py tests/test_native.py \
+    -q -m resilience --continue-on-collection-errors "$@"
+fi
 
 timeout -k 10 600 env JAX_PLATFORMS=cpu \
   python -m pytest tests/ -q -m resilience \

@@ -1,7 +1,12 @@
+import struct
+
 import numpy as np
+import pytest
 
 from deepconsensus_tpu import constants
+from deepconsensus_tpu.faults import CorruptInputError
 from deepconsensus_tpu.io import bam
+from deepconsensus_tpu.io.bam_writer import BamWriter, BgzfWriter
 
 
 def test_read_subreads_bam(testdata_dir):
@@ -71,3 +76,104 @@ def test_read_truth_bam_by_name(testdata_dir):
   for name, records in by_ref.items():
     assert name.endswith('/ccs')
     assert all(r.reference_name == name for r in records)
+
+
+# --- Hardened-decoder regressions (corrupt/truncated inputs) ---------------
+
+
+def _write_tiny_bam(path, tags=None):
+  """One-record BAM whose decompressed bytes are easy to patch."""
+  with BamWriter(path, header_text='@HD\tVN:1.6\n') as w:
+    w.write('m0/1/0_8', 'ACGTACGT', None,
+            tags=tags if tags is not None else {'zm': 1})
+
+
+def _rewrap(raw: bytes, path: str) -> str:
+  with BgzfWriter(path) as w:
+    w.write(bytes(raw))
+  return path
+
+
+def test_truncated_header_names_path_and_offset(tmp_path):
+  src = str(tmp_path / 'tiny.bam')
+  _write_tiny_bam(src)
+  raw = bam.bgzf_decompress_file_py(src)
+  # Cut mid way through the header text: reading it hits EOF.
+  out = _rewrap(raw[:6], str(tmp_path / 'truncated.bam'))
+  with pytest.raises(bam.TruncatedBamError) as exc_info:
+    bam.BamReader(out, use_native=False)
+  err = exc_info.value
+  assert err.path == out
+  assert err.offset is not None
+  assert out in str(err)
+  assert not err.recoverable
+
+
+def test_non_bam_magic_rejected(tmp_path):
+  out = _rewrap(b'XAM\x01' + b'\x00' * 64, str(tmp_path / 'notbam.bam'))
+  with pytest.raises(CorruptInputError, match='magic'):
+    bam.BamReader(out, use_native=False)
+
+
+def test_negative_l_text_rejected(tmp_path):
+  raw = bytearray(b'BAM\x01')
+  raw += struct.pack('<i', -1)  # l_text
+  out = _rewrap(raw, str(tmp_path / 'neg_ltext.bam'))
+  with pytest.raises(CorruptInputError, match='header text length'):
+    bam.BamReader(out, use_native=False)
+
+
+def test_negative_block_size_rejected(tmp_path):
+  src = str(tmp_path / 'tiny.bam')
+  _write_tiny_bam(src)
+  raw = bytearray(bam.bgzf_decompress_file_py(src))
+  # Header is magic + l_text + text + n_ref (no references here).
+  (l_text,) = struct.unpack_from('<i', raw, 4)
+  header_end = 4 + 4 + l_text + 4
+  raw[header_end:header_end + 4] = struct.pack('<i', -5)
+  out = _rewrap(raw, str(tmp_path / 'neg_block.bam'))
+  reader = bam.BamReader(out, use_native=False,
+                         skip_corrupt_records=True)  # not skippable
+  with pytest.raises(CorruptInputError, match='block_size') as exc_info:
+    next(iter(reader))
+  assert not exc_info.value.recoverable
+
+
+def _patch_tag_bytes(raw: bytearray, marker: bytes, at: int,
+                     replacement: bytes) -> None:
+  idx = bytes(raw).find(marker)
+  assert idx >= 0, f'tag marker {marker!r} not found'
+  raw[idx + at:idx + at + len(replacement)] = replacement
+
+
+def test_tag_count_overrun_names_read(tmp_path):
+  """Regression: a B-array whose count field overruns the record must
+  raise a recoverable CorruptInputError naming the read, never allocate
+  the claimed array."""
+  src = str(tmp_path / 'tiny.bam')
+  _write_tiny_bam(src, tags={'pw': np.arange(8)})
+  raw = bytearray(bam.bgzf_decompress_file_py(src))
+  # 'pw' encodes as b'pwBi' + u32 count; inflate the count.
+  _patch_tag_bytes(raw, b'pwBi', 4, struct.pack('<I', 0xFFFFFFFF))
+  out = _rewrap(raw, str(tmp_path / 'tag_overrun.bam'))
+  with pytest.raises(CorruptInputError, match='overruns') as exc_info:
+    list(bam.BamReader(out, use_native=False))
+  err = exc_info.value
+  assert err.recoverable
+  assert 'm0/1/0_8' in str(err)
+  assert out in str(err)
+
+
+def test_unknown_tag_type_names_read_and_file(tmp_path):
+  src = str(tmp_path / 'tiny.bam')
+  _write_tiny_bam(src, tags={'RG': 'grp1'})
+  raw = bytearray(bam.bgzf_decompress_file_py(src))
+  _patch_tag_bytes(raw, b'RGZ', 2, b'Q')  # 'Q' is not a BAM tag type
+  out = _rewrap(raw, str(tmp_path / 'bad_tag_type.bam'))
+  with pytest.raises(CorruptInputError, match='unknown BAM tag type'):
+    list(bam.BamReader(out, use_native=False))
+  # Under the skip policy a tag-corrupt record is recoverable: the
+  # reader steps over it and counts it instead of dying.
+  reader = bam.BamReader(out, use_native=False, skip_corrupt_records=True)
+  assert list(reader) == []
+  assert reader.n_corrupt_records == 1

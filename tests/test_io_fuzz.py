@@ -1,0 +1,473 @@
+"""Corruption-fuzz suite for the hardened decode layer.
+
+The invariant (ISSUE 4): over a deterministic mutant corpus per format
+(BAM, raw BGZF, TFRecord), every mutant either parses, raises
+CorruptInputError (incl. TruncatedBamError), or is skipped under a skip
+policy — never any other exception, never an allocation beyond
+max_record_bytes (plus interpreter slack), never a hang (per-mutant
+alarm). Mutant counts default to 500 per format (acceptance floor) and
+are overridable via DCTPU_FUZZ_MUTANTS for quick local runs.
+
+Also holds the end-to-end degradation acceptance test: one surgically
+corrupted mid-file record + --on_zmw_error=skip -> exactly that
+molecule is dead-lettered, every clean ZMW still polishes.
+"""
+import json
+import os
+import signal
+import tracemalloc
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.faults import CorruptInputError
+from deepconsensus_tpu.io import bam as bam_lib
+from deepconsensus_tpu.io import tfrecord as tfrecord_lib
+from deepconsensus_tpu.io import validate as validate_lib
+from deepconsensus_tpu.io.bam_writer import BgzfWriter
+
+pytestmark = pytest.mark.resilience
+
+N_MUTANTS = int(os.environ.get('DCTPU_FUZZ_MUTANTS', '500'))
+# Tight per-record cap: corpora are tiny, so any decode allocating past
+# this is trusting a corrupt length field.
+CAP_BYTES = 1 << 20
+# Interpreter/numpy slack on top of the cap for the tracemalloc bound.
+ALLOC_SLACK = 8 << 20
+# Sampling stride for the tracemalloc bound (tracing every mutant would
+# triple the suite's runtime for no extra signal).
+TRACE_EVERY = 25
+PER_MUTANT_TIMEOUT_S = 10.0
+
+
+@contextmanager
+def deadline(seconds: float):
+  """Per-mutant hang guard via SIGALRM (CPython honors it between
+  bytecodes, which is exactly where a decode loop would spin)."""
+
+  def on_alarm(signum, frame):
+    raise TimeoutError('decode exceeded per-mutant deadline')
+
+  previous = signal.signal(signal.SIGALRM, on_alarm)
+  signal.setitimer(signal.ITIMER_REAL, seconds)
+  try:
+    yield
+  finally:
+    signal.setitimer(signal.ITIMER_REAL, 0)
+    signal.signal(signal.SIGALRM, previous)
+
+
+def _drain_bam(path: str, skip: bool) -> int:
+  """Consumes every record; returns the count. CorruptInputError is the
+  only exception allowed to escape (and under skip, only the
+  non-recoverable kind)."""
+  n = 0
+  reader = bam_lib.BamReader(path, use_native=False,
+                             max_record_bytes=CAP_BYTES,
+                             skip_corrupt_records=skip)
+  with reader:
+    for _ in reader:
+      n += 1
+  return n
+
+
+def _fuzz_loop(tmp_path, src: bytes, run_one, protect_prefix: int = 0,
+               seed: int = 1234):
+  """Shared harness: for every mutant, run_one(path) must either return
+  or raise CorruptInputError; allocation and wall-clock are bounded."""
+  from scripts import inject_faults
+
+  n_parsed = n_rejected = 0
+  mutant_path = str(tmp_path / 'mutant.bin')
+  for i, mode, data in inject_faults.fuzz_mutants(
+      src, N_MUTANTS, seed=seed, protect_prefix=protect_prefix):
+    with open(mutant_path, 'wb') as f:
+      f.write(data)
+    trace = (i % TRACE_EVERY) == 0
+    if trace:
+      tracemalloc.start()
+    try:
+      with deadline(PER_MUTANT_TIMEOUT_S):
+        try:
+          run_one(mutant_path)
+          n_parsed += 1
+        except CorruptInputError:
+          n_rejected += 1
+        # Anything else (struct.error, ValueError, MemoryError,
+        # UnicodeDecodeError, TimeoutError...) propagates and fails
+        # the test — that IS the invariant.
+    finally:
+      if trace:
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert peak < CAP_BYTES + ALLOC_SLACK, (
+            f'mutant {i} ({mode}) allocated {peak} bytes '
+            f'(cap {CAP_BYTES} + slack {ALLOC_SLACK})')
+  # A corpus where nothing was ever rejected means the mutator is too
+  # weak to exercise the defenses; a corpus where nothing parses means
+  # the baseline file itself is broken.
+  assert n_rejected > 0
+  assert n_parsed + n_rejected == N_MUTANTS
+
+
+# ----------------------------------------------------------------------
+# Per-format fuzz invariants
+
+
+def test_fuzz_bam_fail_fast(tmp_path, synthetic_bams):
+  subreads, _ = synthetic_bams('fuzz_bam', n_zmws=3, n_subreads=2,
+                               seq_len=60)
+  with open(subreads, 'rb') as f:
+    src = f.read()
+  _fuzz_loop(tmp_path, src, lambda p: _drain_bam(p, skip=False))
+
+
+def test_fuzz_bam_skip_policy(tmp_path, synthetic_bams):
+  """Same corpus under skip_corrupt_records: recoverable damage is
+  swallowed; only stream-level CorruptInputError may escape."""
+  subreads, _ = synthetic_bams('fuzz_bam_skip', n_zmws=3, n_subreads=2,
+                               seq_len=60)
+  with open(subreads, 'rb') as f:
+    src = f.read()
+  _fuzz_loop(tmp_path, src, lambda p: _drain_bam(p, skip=True),
+             seed=4321)
+
+
+def test_fuzz_bam_uncompressed_records(tmp_path, synthetic_bams):
+  """Mutates the DECOMPRESSED BAM byte stream (BGZF container stays
+  pristine), so every mutant exercises the record decoder rather than
+  dying in gzip. The header prefix is shielded to reach the per-record
+  paths."""
+  subreads, _ = synthetic_bams('fuzz_bam_raw', n_zmws=3, n_subreads=2,
+                               seq_len=60)
+  raw = bam_lib.bgzf_decompress_file_py(subreads)
+  # Shield magic + l_text so mutants pass the header and hit records.
+  protect = 8 + int(np.frombuffer(raw[4:8], dtype='<i4')[0])
+
+  from scripts import inject_faults
+
+  n_parsed = n_rejected = 0
+  mutant_path = str(tmp_path / 'mutant.bam')
+  for i, mode, data in inject_faults.fuzz_mutants(
+      raw, N_MUTANTS, seed=77, protect_prefix=protect):
+    writer = BgzfWriter(mutant_path)
+    writer.write(data)
+    writer.close()
+    with deadline(PER_MUTANT_TIMEOUT_S):
+      try:
+        _drain_bam(mutant_path, skip=(i % 2 == 0))
+        n_parsed += 1
+      except CorruptInputError:
+        n_rejected += 1
+  assert n_rejected > 0
+  assert n_parsed + n_rejected == N_MUTANTS
+
+
+def test_fuzz_raw_bgzf(tmp_path):
+  """Raw BGZF container fuzz via the pure-Python whole-file
+  decompressor (the BamReader fallback's gzip layer)."""
+  src_path = str(tmp_path / 'seed.bgzf')
+  writer = BgzfWriter(src_path)
+  rng = np.random.RandomState(5)
+  writer.write(rng.bytes(200_000))
+  writer.close()
+  with open(src_path, 'rb') as f:
+    src = f.read()
+  _fuzz_loop(
+      tmp_path, src,
+      lambda p: bam_lib.bgzf_decompress_file_py(p, max_out=CAP_BYTES))
+
+
+def test_fuzz_tfrecord(tmp_path, scripts_importable):
+  from scripts import inject_faults
+
+  shard = inject_faults.write_synthetic_tfrecords(
+      str(tmp_path / 'shards'), n_shards=1, n_examples=24)[0]
+  with open(shard, 'rb') as f:
+    src = f.read()
+
+  def run_one(path):
+    with tfrecord_lib.TFRecordReader(path, compression='GZIP',
+                                     check_crc=True,
+                                     max_record_bytes=CAP_BYTES) as reader:
+      for _ in reader:
+        pass
+
+  _fuzz_loop(tmp_path, src, run_one)
+
+
+def test_fuzz_tfrecord_uncompressed(tmp_path):
+  """Uncompressed shard: mutants hit the TFRecord framing itself
+  (length caps + unconditional length-CRC), not the gzip layer."""
+  shard = str(tmp_path / 'seed.tfrecord')
+  rng = np.random.RandomState(11)
+  with tfrecord_lib.TFRecordWriter(shard) as writer:
+    for _ in range(50):
+      writer.write(rng.bytes(int(rng.randint(10, 2000))))
+  with open(shard, 'rb') as f:
+    src = f.read()
+
+  def run_one(path):
+    with tfrecord_lib.TFRecordReader(path,
+                                     max_record_bytes=CAP_BYTES) as reader:
+      for _ in reader:
+        pass
+
+  _fuzz_loop(tmp_path, src, run_one)
+
+
+# ----------------------------------------------------------------------
+# Targeted regressions the fuzzer motivates
+
+
+def test_tfrecord_length_inflation_never_allocates(tmp_path):
+  """A corrupt 8-byte length claiming 2**62 bytes must be rejected by
+  the length-CRC check before any allocation — even with
+  check_crc=False."""
+  shard = str(tmp_path / 'bomb.tfrecord')
+  with tfrecord_lib.TFRecordWriter(shard) as writer:
+    writer.write(b'payload-one')
+  with open(shard, 'r+b') as f:
+    f.write((1 << 62).to_bytes(8, 'little'))  # inflate length, stale CRC
+  tracemalloc.start()
+  try:
+    with pytest.raises(CorruptInputError, match='length crc'):
+      for _ in tfrecord_lib.TFRecordReader(shard):
+        pass
+    _, peak = tracemalloc.get_traced_memory()
+  finally:
+    tracemalloc.stop()
+  assert peak < ALLOC_SLACK
+
+
+def test_tfrecord_crc_valid_oversize_hits_cap(tmp_path):
+  """A length over the cap with a VALID crc (attacker fixes the crc)
+  still refuses to allocate: the cap check is independent of the CRC."""
+  shard = str(tmp_path / 'capped.tfrecord')
+  with tfrecord_lib.TFRecordWriter(shard) as writer:
+    writer.write(b'x' * 64)
+  with open(shard, 'r+b') as f:
+    import struct
+
+    header = struct.pack('<Q', 1 << 40)
+    f.write(header)
+    f.write(struct.pack('<I', tfrecord_lib._masked_crc(header)))
+  with pytest.raises(CorruptInputError, match='max_record_bytes'):
+    for _ in tfrecord_lib.TFRecordReader(shard,
+                                         max_record_bytes=CAP_BYTES):
+      pass
+
+
+def test_bam_block_size_inflation_skips_without_alloc(tmp_path,
+                                                      synthetic_bams):
+  """block_size inflated to 1 GiB: the reader must consume in bounded
+  chunks (no 1 GiB allocation) and raise typed."""
+  subreads, _ = synthetic_bams('inflate', n_zmws=2, n_subreads=2,
+                               seq_len=60)
+  from scripts import inject_faults
+
+  out = str(tmp_path / 'inflated.bam')
+  inject_faults.corrupt_bam_record(subreads, out, record_index=1,
+                                   mode='block_size_inflate')
+  tracemalloc.start()
+  try:
+    with pytest.raises(CorruptInputError):
+      _drain_bam(out, skip=False)
+    _, peak = tracemalloc.get_traced_memory()
+  finally:
+    tracemalloc.stop()
+  assert peak < CAP_BYTES + ALLOC_SLACK
+
+
+@pytest.mark.parametrize('mode', ['read_name_zero', 'read_name_overrun',
+                                  'cigar_overrun'])
+def test_bam_record_body_damage_is_recoverable(tmp_path, synthetic_bams,
+                                               mode):
+  """Framing-intact record damage: fail-fast raises a recoverable
+  CorruptInputError; skip mode yields every OTHER record."""
+  subreads, _ = synthetic_bams(f'body_{mode}', n_zmws=3, n_subreads=2,
+                               seq_len=60)
+  total = _drain_bam(subreads, skip=False)
+  out = str(tmp_path / 'damaged.bam')
+  from scripts import inject_faults
+
+  inject_faults.corrupt_bam_record(subreads, out, record_index=2,
+                                   mode=mode)
+  with pytest.raises(CorruptInputError) as err:
+    _drain_bam(out, skip=False)
+  assert err.value.recoverable
+  assert err.value.path == out
+  reader = bam_lib.BamReader(out, use_native=False,
+                             skip_corrupt_records=True)
+  with reader:
+    survivors = sum(1 for _ in reader)
+  assert survivors == total - 1
+  assert reader.n_corrupt_records == 1
+
+
+# ----------------------------------------------------------------------
+# End-to-end degradation + preflight acceptance
+
+
+def _run_skip_policy_inference(tmp_path, subreads, ccs):
+  """Runs the real inference pipeline (tiny model, no jit) with
+  --on_zmw_error=skip over the given pair."""
+  from deepconsensus_tpu.inference import runner as runner_lib
+  from deepconsensus_tpu.models import config as config_lib
+
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  options = runner_lib.InferenceOptions(
+      batch_size=8, batch_zmws=2, min_quality=0, skip_windows_above=1,
+      on_zmw_error='skip', max_record_bytes=CAP_BYTES,
+  )
+  output = str(tmp_path / 'out.fastq')
+  model_runner = runner_lib.ModelRunner(params, {}, options)
+  counters = runner_lib.run_inference(subreads, ccs, None, output,
+                                      options=options, runner=model_runner)
+  return output, counters
+
+
+def test_corrupt_midfile_record_quarantines_and_run_completes(
+    tmp_path, synthetic_bams):
+  """ISSUE 4 acceptance: with --on_zmw_error=skip, one corrupt mid-file
+  subread record dead-letters its molecule at the decode stage and the
+  run completes with output for every clean ZMW."""
+  subreads, ccs = synthetic_bams('e2e', n_zmws=5, n_subreads=3,
+                                 seq_len=60)
+  from scripts import inject_faults
+
+  corrupt = str(tmp_path / 'corrupt_subreads.bam')
+  # Record 7 = mid-molecule of ZMW 102 (3 subreads per ZMW).
+  inject_faults.corrupt_bam_record(subreads, corrupt, record_index=7,
+                                   mode='read_name_overrun')
+  output, counters = _run_skip_policy_inference(tmp_path, corrupt, ccs)
+  assert counters['n_corrupt_records'] == 1
+  # Clean molecules all made it to the output.
+  from deepconsensus_tpu.io import fastx
+
+  names = [name for name, _, _ in fastx.read_fastq(output)]
+  assert len(names) == 4
+  assert not any('/102/' in name for name in names)
+  # The poisoned molecule is attributed in the dead-letter sidecar.
+  letters = [json.loads(line)
+             for line in open(output + '.failed.jsonl')]
+  assert len(letters) == 1
+  assert letters[0]['stage'] == 'decode'
+  assert '102' in (letters[0]['zmw'] or '')
+
+
+def test_validate_clean_pair_ok(tmp_path, synthetic_bams):
+  subreads, ccs = synthetic_bams('validate_clean')
+  report = validate_lib.validate_inputs(subreads_to_ccs=subreads,
+                                        ccs_bam=ccs)
+  assert report['ok'], report
+  assert report['n_errors'] == 0
+  assert report['pair']['ok']
+  for entry in report['files']:
+    assert entry['bgzf_eof']
+    assert entry['n_records'] > 0
+
+
+def test_validate_cli_exit_codes_and_json(tmp_path, synthetic_bams,
+                                          capsys):
+  """dctpu validate: 0 on a clean corpus; nonzero + JSON naming file and
+  offset on each mutant class (truncation, record damage, bad CRC)."""
+  from scripts import inject_faults
+
+  from deepconsensus_tpu import cli
+
+  subreads, ccs = synthetic_bams('validate_cli')
+  assert cli.main(['validate', '--subreads_to_ccs', subreads,
+                   '--ccs_bam', ccs]) == 0
+  capsys.readouterr()
+
+  # Mutant class 1: truncated tail (missing BGZF EOF).
+  truncated = str(tmp_path / 'trunc.bam')
+  with open(subreads, 'rb') as f:
+    data = f.read()
+  with open(truncated, 'wb') as f:
+    f.write(data[:len(data) // 2])
+  rc = cli.main(['validate', '--subreads_to_ccs', truncated])
+  report = json.loads(capsys.readouterr().out)
+  assert rc == 1
+  assert any(e['file'] == truncated for e in report['files'][0]['errors'])
+
+  # Mutant class 2: framing-intact record damage (file + offset named).
+  damaged = str(tmp_path / 'damaged.bam')
+  offset = inject_faults.corrupt_bam_record(subreads, damaged,
+                                            record_index=3,
+                                            mode='cigar_overrun')
+  report_path = str(tmp_path / 'report.json')
+  rc = cli.main(['validate', '--subreads_to_ccs', damaged,
+                 '--report', report_path])
+  capsys.readouterr()
+  assert rc == 1
+  report = json.load(open(report_path))
+  entry = report['files'][0]
+  assert entry['n_corrupt_records'] == 1
+  assert entry['errors'][0]['file'] == damaged
+  assert entry['errors'][0]['offset'] == offset
+
+  # Mutant class 3: TFRecord CRC corruption.
+  shard = inject_faults.write_synthetic_tfrecords(
+      str(tmp_path / 'shards'), n_shards=1, n_examples=8)[0]
+  with open(shard, 'rb') as f:
+    sdata = bytearray(f.read())
+  sdata[len(sdata) // 2] ^= 0xFF
+  bad_shard = str(tmp_path / 'bad.tfrecord.gz')
+  with open(bad_shard, 'wb') as f:
+    f.write(sdata)
+  rc = cli.main(['validate', '--tfrecord', bad_shard])
+  report = json.loads(capsys.readouterr().out)
+  assert rc == 1
+  assert report['files'][0]['errors'][0]['file'] == bad_shard
+
+
+def test_validate_detects_pair_mismatch(tmp_path, synthetic_bams):
+  """actc referencing a ccs read that is absent from the ccs BAM."""
+  subreads, _ = synthetic_bams('pair_a', n_zmws=4)
+  _, other_ccs = synthetic_bams('pair_b', n_zmws=2)
+  report = validate_lib.validate_inputs(subreads_to_ccs=subreads,
+                                        ccs_bam=other_ccs)
+  assert not report['ok']
+  assert not report['pair']['ok']
+  assert any('absent from the ccs BAM' in e['error']
+             for e in report['pair']['errors'])
+
+
+def test_training_skip_policy_counts_corrupt_records(tmp_path,
+                                                     scripts_importable):
+  """A corrupt shard under on_shard_error=skip surfaces as both
+  n_shard_errors and n_corrupt_records (the faults metrics split,
+  train.py merges stream_ds.counters into it)."""
+  from scripts import inject_faults
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models.data import StreamingDataset
+
+  paths = inject_faults.write_synthetic_tfrecords(
+      str(tmp_path / 'shards'), n_shards=2, n_examples=32,
+      max_passes=5, max_length=20)
+  with open(paths[0], 'rb') as f:
+    data = bytearray(f.read())
+  data[len(data) // 2] ^= 0xFF  # mid-stream BGZF bit flip
+  with open(paths[0], 'wb') as f:
+    f.write(data)
+  params = config_lib.get_config('fc+test')
+  with params.unlocked():
+    params.max_passes = 5
+    params.max_length = 20
+  config_lib.finalize_params(params)
+  ds = StreamingDataset(patterns=paths, params=params, batch_size=8,
+                        buffer_size=16, seed=0, on_shard_error='skip')
+  it = iter(ds)
+  try:
+    batches = [next(it) for _ in range(4)]  # > one pass over the pair
+  finally:
+    it.close()
+  assert all(b['rows'].shape[0] == 8 for b in batches)
+  # The flip surfaces as record-local payload corruption, a framing
+  # CorruptInputError ending the shard, or both — always attributed.
+  assert ds.counters['n_corrupt_records'] >= 1

@@ -35,3 +35,90 @@ def test_bgzf_decompress_roundtrip_with_our_writer(lib, tmp_path):
     w.write(payload)
   out = native.bgzf_decompress_file(path)
   assert out == payload
+
+
+def test_bgzf_decompress_file_respects_max_out(lib, tmp_path):
+  from deepconsensus_tpu.io.bam_writer import BgzfWriter
+
+  path = str(tmp_path / 'data.bgzf')
+  payload = bytes(range(256)) * 1000
+  with BgzfWriter(path) as w:
+    w.write(payload)
+  assert native.bgzf_decompress_file(path, max_out=1024) is None
+  assert native.bgzf_decompress_file(path, max_out=len(payload)) == payload
+
+
+@pytest.mark.resilience
+def test_bgzf_corrupt_input_parity(lib, tmp_path):
+  """ISSUE 4 satellite: the same mutated BGZF file must produce the
+  same accept/reject outcome through native bgzf_decompress_file and
+  the pure-Python path — in particular the native path must NEVER
+  accept bytes (or different bytes) where Python rejects or differs."""
+  import os
+
+  from deepconsensus_tpu.faults import CorruptInputError
+  from deepconsensus_tpu.io.bam_writer import BgzfWriter
+  from scripts import inject_faults
+
+  src_path = str(tmp_path / 'seed.bgzf')
+  import numpy as np
+
+  rng = np.random.RandomState(3)
+  with BgzfWriter(src_path) as w:
+    w.write(rng.bytes(150_000))
+  with open(src_path, 'rb') as f:
+    src = f.read()
+  mutant = str(tmp_path / 'mutant.bgzf')
+  n_mutants = int(os.environ.get('DCTPU_FUZZ_MUTANTS', '500'))
+  n_py_rejects = n_native_rejects = 0
+  for i, mode, data in inject_faults.fuzz_mutants(src, n_mutants,
+                                                  seed=99):
+    with open(mutant, 'wb') as f:
+      f.write(data)
+    try:
+      py_out = bam.bgzf_decompress_file_py(mutant)
+    except CorruptInputError:
+      py_out = None
+      n_py_rejects += 1
+    native_out = native.bgzf_decompress_file(mutant)
+    if native_out is None:
+      n_native_rejects += 1
+    if py_out is None:
+      assert native_out is None, (
+          f'mutant {i} ({mode}): native accepted input Python rejects')
+    elif native_out is not None:
+      assert native_out == py_out, (
+          f'mutant {i} ({mode}): native decoded different bytes')
+  assert n_py_rejects > 0  # the corpus exercised the reject paths
+
+
+@pytest.mark.resilience
+def test_tfrecord_corrupt_native_falls_back_to_typed_error(lib, tmp_path):
+  """A TFRecord shard with a corrupt length header: the native
+  whole-shard decode returns None (framing reject) and the streaming
+  path raises CorruptInputError — no bare error through either path."""
+  from deepconsensus_tpu.faults import CorruptInputError
+
+  path = str(tmp_path / 'shard.tfrecord')
+  with tfrecord.TFRecordWriter(path) as w:
+    w.write(b'payload-a')
+    w.write(b'payload-b')
+  with open(path, 'r+b') as f:
+    f.write((1 << 50).to_bytes(8, 'little'))  # inflate first length
+  assert native.read_tfrecord_records(path, compressed=False) is None
+  with pytest.raises(CorruptInputError):
+    for _ in tfrecord.TFRecordReader(path):
+      pass
+
+
+def test_native_tfrecord_validates_length_crc(lib, tmp_path):
+  """The native indexer must reject a length whose CRC does not match
+  even when the inflated length still fits the buffer (framing
+  desync), matching the hardened Python reader."""
+  path = str(tmp_path / 'shard.tfrecord')
+  with tfrecord.TFRecordWriter(path) as w:
+    w.write(b'x' * 100)
+    w.write(b'y' * 100)
+  with open(path, 'r+b') as f:
+    f.write((5).to_bytes(8, 'little'))  # plausible but CRC-stale length
+  assert native.read_tfrecord_records(path, compressed=False) is None
