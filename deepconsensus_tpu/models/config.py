@@ -270,6 +270,11 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.embed_onehot = False
   params.attn_softmax_dtype = ml_collections.config_dict.placeholder(str)
   params.use_pallas_attention = False
+  # Batch-major fused embed->condense->layer-0-attention Pallas kernel
+  # for the short-window (L<=128) inference hot path
+  # (ops/fused_window_attention.py). Falls back to the XLA path for
+  # training, init, non-condensed/non-ReZero configs, and long windows.
+  params.use_fused_hotpath = False
   # Route AlignmentLoss through the whole-DP Pallas wavefront kernels
   # (forward scorer + custom-VJP backward) instead of the lax.scan DP.
   # Only applies when band_width is None (the training default).

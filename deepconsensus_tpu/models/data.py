@@ -149,10 +149,13 @@ def parse_example_minimal(
 
 def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
                        chunk: int = 64, on_shard_error: str = 'fail',
-                       with_name: bool = False) -> None:
+                       with_name: bool = False,
+                       worker_idx: int = -1) -> None:
   """StreamingDataset worker: reads its shard subset forever (gzip +
   framing + minimal parse all inside this process) and ships parsed
-  chunks to the parent as ('chunk', parses) tuples. A shard that fails
+  chunks to the parent as ('chunk', (worker_idx, parses)) tuples — the
+  index feeds the parent's per-worker decode counters. A shard that
+  fails
   to decode under on_shard_error='skip' is reported as a
   ('shard_error', description) tuple and the worker moves on; under
   'fail' the worker exits nonzero and the parent's liveness check
@@ -185,7 +188,7 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
           pending.append(parsed)
           produced = True
           if len(pending) >= chunk:
-            out_queue.put(('chunk', pending))
+            out_queue.put(('chunk', (worker_idx, pending)))
             pending = []
       except Exception as e:  # noqa: BLE001 - policy-gated
         if on_shard_error != OnShardError.SKIP:
@@ -434,7 +437,7 @@ class StreamingDataset:
       proc = ctx.Process(
           target=_shard_reader_main,
           args=(worker_paths[w], self.inference, self.seed + w, out_queue,
-                64, self.on_shard_error, self._with_name),
+                64, self.on_shard_error, self._with_name, w),
           daemon=True,
       )
       proc.start()
@@ -493,7 +496,13 @@ class StreamingDataset:
           log.warning('on_shard_error=skip: worker skipped record (%s)',
                       payload)
           continue
-        yield from payload
+        w_idx, parses = payload
+        # Per-worker decode counters: with N workers on an M-core host
+        # these prove (or disprove) that the decode load actually
+        # splits ~evenly — the evidence behind any "N workers -> ~N x
+        # throughput" extrapolation (docs/training.md).
+        self.counters[f'n_parsed_worker_{w_idx}'] += len(parses)
+        yield from parses
     finally:
       for proc in procs:
         proc.terminate()
@@ -689,13 +698,19 @@ def augment_batch(
   )
   order_keys = np.where(kept, order_keys, 2.0)
   order = np.argsort(order_keys, axis=1, kind='stable')  # [B, P]
-  if perm_on.any() or (keep < n_present).any():
+  fired = perm_on | (keep < n_present)  # [B]
+  if fired.any():
     sel = np.take_along_axis(
         blocks, order[:, None, :, None], axis=2
     )  # [B, 4, P, L]
     # Zero out dropped tail (and previously-absent rows stay zero).
     live = np.arange(p)[None, :] < keep[:, None]  # [B, P]
     sel = np.where(live[:, None, :, None], sel, 0.0)
+    # Gate the write per-example: for an example where neither
+    # transform fired, the gather is only the identity if its present
+    # subreads are front-compacted — an example with an interior
+    # all-zero row would be silently compacted by the batch-wide write.
+    sel = np.where(fired[:, None, None, None], sel, blocks)
     rows[:, : 4 * p, :, 0] = sel.reshape(b, 4 * p, length)
     blocks = rows[:, : 4 * p, :, 0].reshape(b, 4, p, length)
     bases, pw, ip, strand = (blocks[:, i] for i in range(4))

@@ -242,7 +242,8 @@ class EncoderStack(nn.Module):
   dtype: Any = jnp.float32
 
   @nn.compact
-  def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+  def __call__(self, x: jnp.ndarray, deterministic: bool,
+               skip_first_attention: bool = False) -> jnp.ndarray:
     p = self.params
 
     # Optional rematerialization: drop each residual block's
@@ -256,25 +257,32 @@ class EncoderStack(nn.Module):
       run_block = nn.remat(run_block)
 
     for n in range(p.num_hidden_layers):
-      attn = BandedSelfAttention(
-          hidden_size=p.hidden_size,
-          num_heads=p.num_heads,
-          dropout_rate=p.attention_dropout,
-          attn_win_size=p.attn_win_size,
-          dtype=self.dtype,
-          use_pallas=p.get('use_pallas_attention', False),
-          softmax_dtype=jnp.dtype(
-              p.get('attn_softmax_dtype', None) or 'float32'),
-          name=f'self_attention_{n}',
-      )
-      x = run_block(
-          ResidualWrapper(
-              attn, rezero=p.rezero,
-              dropout_rate=p.layer_postprocess_dropout,
-              name=f'attention_wrapper_{n}',
-          ),
-          x,
-      )
+      if skip_first_attention and n == 0:
+        # The fused hot path (ops/fused_window_attention.py) already
+        # applied attention_wrapper_0's block including the residual;
+        # module names below stay aligned so the param tree is
+        # unchanged (init never takes this branch).
+        pass
+      else:
+        attn = BandedSelfAttention(
+            hidden_size=p.hidden_size,
+            num_heads=p.num_heads,
+            dropout_rate=p.attention_dropout,
+            attn_win_size=p.attn_win_size,
+            dtype=self.dtype,
+            use_pallas=p.get('use_pallas_attention', False),
+            softmax_dtype=jnp.dtype(
+                p.get('attn_softmax_dtype', None) or 'float32'),
+            name=f'self_attention_{n}',
+        )
+        x = run_block(
+            ResidualWrapper(
+                attn, rezero=p.rezero,
+                dropout_rate=p.layer_postprocess_dropout,
+                name=f'attention_wrapper_{n}',
+            ),
+            x,
+        )
       ffn = FeedForward(
           hidden_size=p.hidden_size,
           filter_size=p.filter_size,
@@ -388,6 +396,67 @@ class DeepConsensusModel(nn.Module):
       blocks.append(gather(self.sn_embedding, sn_r))
     return jnp.concatenate(blocks, axis=-1)
 
+  def _fused_hotpath_eligible(self, rows: jnp.ndarray, train: bool) -> bool:
+    """True when this apply can route through the batch-major fused
+    embed->condense->attention kernel. Init always runs the XLA path so
+    the param tree is created identically; training needs gradients and
+    dropout the kernel doesn't serve; the kernel assumes the condensed
+    learn-values input, a ReZero residual for layer 0, and a window
+    short enough for whole-L score blocks."""
+    from deepconsensus_tpu.ops import fused_window_attention as fwa
+
+    p = self.params
+    return bool(
+        p.get('use_fused_hotpath', False)
+        and not train
+        and not self.is_initializing()
+        and self.learn_values
+        and p.condense_transformer_input
+        and p.rezero
+        and p.num_hidden_layers >= 1
+        and rows.shape[-1] <= fwa.MAX_WINDOW_LEN
+    )
+
+  def _fused_forward(self, rows: jnp.ndarray) -> jnp.ndarray:
+    """Embed+condense+pos+layer-0 attention block via the fused Pallas
+    kernel; returns activations ready for the remaining encoder blocks
+    (call the encoder with skip_first_attention=True)."""
+    from deepconsensus_tpu.ops import fused_window_attention as fwa
+
+    p = self.params
+    specs, table_keys, _ = fwa.build_family_specs(p)
+    params = self.variables['params']
+    tables = {k: params[f'{k}_embedding']['embedding'] for k in table_keys}
+    h = p.hidden_size
+    # Sublayers are constructed outside ResidualWrapper, so Flax names
+    # them as siblings of their wrapper inside the encoder scope.
+    attn0 = params['encoder']['self_attention_0']
+    wrap0 = params['encoder']['attention_wrapper_0']
+    pos = None
+    if p.add_pos_encoding:
+      pos = jnp.asarray(
+          sinusoidal_position_encoding(rows.shape[-1], h),
+          self.compute_dtype)
+    x_base, attn_out = fwa.fused_embed_condense_attention(
+        rows,
+        tables,
+        params['condenser']['kernel'],
+        attn0['query']['kernel'].reshape(h, h),
+        attn0['key']['kernel'].reshape(h, h),
+        attn0['value']['kernel'].reshape(h, h),
+        attn0['output_transform']['kernel'].reshape(h, h),
+        pos,
+        specs=specs,
+        table_keys=table_keys,
+        num_heads=p.num_heads,
+        attn_win_size=p.attn_win_size or None,
+        softmax_dtype=jnp.dtype(p.get('attn_softmax_dtype', None)
+                                or 'float32'),
+        compute_dtype=self.compute_dtype,
+    )
+    alpha = wrap0['alpha']
+    return x_base + alpha.astype(x_base.dtype) * attn_out
+
   def __call__(
       self, rows: jnp.ndarray, train: bool = False
   ) -> jnp.ndarray:
@@ -401,6 +470,13 @@ class DeepConsensusModel(nn.Module):
     deterministic = not train
     if rows.ndim == 4:
       rows = jnp.squeeze(rows, -1)
+    if self._fused_hotpath_eligible(rows, train):
+      x = self._fused_forward(rows)
+      encoded = self.encoder(
+          x, deterministic=True, skip_first_attention=True)
+      logits = self.logits_layer(encoded.astype(jnp.float32))
+      preds = jax.nn.softmax(logits, axis=-1)
+      return {'final_output': encoded, 'logits': logits, 'preds': preds}
     if self.learn_values:
       x = self._embed_rows(rows)
       if p.condense_transformer_input:

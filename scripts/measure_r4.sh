@@ -54,6 +54,18 @@ run_stage forward_onehot 600 \
 run_stage forward_bf16_softmax 600 \
   python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
   --set attn_softmax_dtype=bfloat16
+# Fused hot-path A/B (round-6 beat-or-retire, VERDICT #3): batch-major
+# Pallas embed->condense->attention vs the XLA default at the
+# production L=100. Compare 'full' windows/s against forward_profile's
+# b1024 line; the fused kernel also folds in the onehot + softmax-dtype
+# levers, so read it against those stages too.
+run_stage forward_fused 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --set use_fused_hotpath=true
+run_stage forward_fused_tile16 600 \
+  env DC_TPU_FUSED_TILE=16 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --set use_fused_hotpath=true
 run_stage e2e_depth8 1200 \
   python "$REPO/scripts/bench_e2e.py" --repeats 6 --depth 8
 run_stage e2e_depth1 600 \

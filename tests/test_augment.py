@@ -301,3 +301,40 @@ def test_rc_with_ccs_bq_row(bq_batch_and_params):
   twice = data_lib.augment_batch(out, p, np.random.default_rng(12))
   np.testing.assert_array_equal(twice['rows'], batch['rows'])
   np.testing.assert_array_equal(twice['label'], batch['label'])
+
+
+def test_unfired_example_with_interior_absent_subread_untouched():
+  """The combined perm/drop gather is only the identity for an
+  example where neither transform fired if its present subreads are
+  front-compacted; the write must be gated per-example so an example
+  with an interior all-zero subread row passes through byte-identical
+  (review regression, ADVICE round-5)."""
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params)
+  mp, length = params.max_passes, params.max_length
+  b = 4
+  rows = np.zeros((b, params.total_rows, length, 1), np.float32)
+
+  def set_subread(example, slot, base):
+    rows[example, slot, :, 0] = base  # bases
+    rows[example, 3 * mp + slot, :, 0] = 1.0  # strand FORWARD
+  # Example 0: subreads 0 and 2 present, slot 1 an interior hole.
+  set_subread(0, 0, 1.0)
+  set_subread(0, 2, 3.0)
+  # Remaining examples: two front-compacted subreads.
+  for ex in range(1, b):
+    set_subread(ex, 0, 2.0)
+    set_subread(ex, 1, 4.0)
+  batch = {'rows': rows,
+           'label': np.zeros((b, length), np.int64)}
+  p = with_probs(params, augment_perm_prob=0.5)
+  # Find a seed whose FIRST rng draw (perm_on) skips example 0 but
+  # fires for at least one other example, mirroring augment_batch's
+  # draw order.
+  seed = next(
+      s for s in range(1000)
+      if (lambda m: not m[0] and m[1:].any())(
+          np.random.default_rng(s).random(b) < 0.5))
+  out = data_lib.augment_batch(batch, p, np.random.default_rng(seed))
+  assert not np.array_equal(out['rows'], batch['rows'])  # someone fired
+  np.testing.assert_array_equal(out['rows'][0], batch['rows'][0])

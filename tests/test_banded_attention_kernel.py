@@ -112,6 +112,9 @@ def test_dropout_vjp_matches_masked_reference():
     )
 
 
+@pytest.mark.slow
+
+
 def test_model_trains_with_pallas_attention():
   """Full train step (dropout on) through the fused attention VJP."""
   import jax

@@ -197,6 +197,8 @@ class TestWatchdog:
   """Acceptance (b): SIGKILLing a pool worker mid-batch triggers the
   watchdog retry and output is byte-identical to an uninterrupted run."""
 
+  @pytest.mark.slow
+
   def test_sigkilled_worker_retries_byte_identical(
       self, synthetic_bams, inject, tmp_path, params, monkeypatch):
     subreads, ccs = synthetic_bams()
@@ -232,6 +234,8 @@ class TestWatchdog:
         if 'dctpu' in p or 'psm' in p
     }
     assert not leaked, f'leaked shm segments: {leaked}'
+
+  @pytest.mark.slow
 
   def test_watchdog_exhaustion_quarantines_batch(
       self, synthetic_bams, inject, tmp_path, params, monkeypatch):

@@ -47,6 +47,7 @@ def test_pallas_scorer_non_divisible_batch():
 
 
 @pytest.mark.parametrize('loss_reg', [0.1, 1.0])
+@pytest.mark.slow
 def test_pallas_vjp_grads_match_scan(loss_reg):
   """Custom-VJP backward kernel vs jax.grad of the scan DP."""
   import jax
@@ -84,6 +85,7 @@ def test_pallas_vjp_grads_match_scan(loss_reg):
   )
 
 
+@pytest.mark.slow
 def test_pallas_vjp_hard_min_grads():
   """Hard-min (loss_reg=None) grads match the scan DP's subgradient."""
   import jax
@@ -114,6 +116,7 @@ def test_pallas_vjp_hard_min_grads():
   )
 
 
+@pytest.mark.slow
 def test_alignment_loss_pallas_path_trains():
   """AlignmentLoss(use_pallas=True) values + grads match the scan path."""
   import jax
@@ -169,6 +172,7 @@ def test_auto_unroll_respects_vmem_budget():
   assert wp._auto_unroll(8, 1 << 20, 6 * 512 + 4) == 1
 
 
+@pytest.mark.slow
 def test_unroll_invariance():
   """Scores and gradients are bit-identical in expectation across
   unroll factors (the block padding/masking algebra must not leak into
@@ -260,6 +264,7 @@ def test_banded_pallas_width_wider_than_matrix():
 
 
 @pytest.mark.parametrize('loss_reg', [0.1, 1.0, None])
+@pytest.mark.slow
 def test_banded_pallas_vjp_grads_match_scan(loss_reg):
   """Banded custom-VJP backward vs jax.grad of the banded scan DP
   (hard-min included: tie-averaged subgradients match the scan's)."""
@@ -293,6 +298,7 @@ def test_banded_pallas_vjp_grads_match_scan(loss_reg):
       np.asarray(got_di), np.asarray(want_di), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_banded_pallas_unroll_invariance():
   """Banded scores and grads are invariant to the unroll choice (block
   padding/masking algebra must not leak into values)."""
@@ -326,6 +332,7 @@ def test_banded_pallas_unroll_invariance():
                                  rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_alignment_loss_banded_pallas_path_trains():
   """AlignmentLoss(width=4, use_pallas=True) values + grads match the
   banded scan path end-to-end through the loss wrapper."""
