@@ -147,6 +147,61 @@ def _add_run(sub):
                  '--on_zmw_error=skip) instead of allocated.')
 
 
+def _add_serve(sub):
+  p = sub.add_parser(
+      'serve',
+      help='Resident consensus service: keep the compiled forward '
+      'warm and polish molecules over a local HTTP endpoint.')
+  p.add_argument('--checkpoint', default=None,
+                 help='Checkpoint or exported-artifact dir (required '
+                 'unless --random_init).')
+  p.add_argument('--host', default='127.0.0.1')
+  p.add_argument('--port', type=int, default=8764,
+                 help='Listen port (0 = pick a free port; the bound '
+                 'port is printed in the ready line).')
+  p.add_argument('--batch_size', type=int, default=1024)
+  p.add_argument('--dispatch_depth', type=int, default=8)
+  p.add_argument('--min_length', type=int, default=0)
+  p.add_argument('--min_quality', type=int, default=20)
+  p.add_argument('--skip_windows_above', type=int, default=45)
+  p.add_argument('--max_base_quality', type=int, default=93)
+  p.add_argument('--dc_calibration', default=None)
+  p.add_argument('--ccs_calibration', default='skip')
+  p.add_argument('--max_pending', type=int, default=64,
+                 help='Outstanding admitted requests before new ones '
+                 'are shed with 429 backpressure.')
+  p.add_argument('--admit_queue_depth', type=int, default=32,
+                 help='Requests queued ahead of the model loop before '
+                 'admission sheds with 429.')
+  p.add_argument('--max_windows_per_request', type=int, default=512)
+  p.add_argument('--max_body_mb', type=int, default=64,
+                 help='Request bodies above this are rejected (413) '
+                 'before any bytes are read.')
+  p.add_argument('--default_deadline_s', type=float, default=120.0,
+                 help='Per-request deadline when the client sends no '
+                 'X-Dctpu-Deadline-S header; expiry cancels the '
+                 'request (504) and reclaims its queued windows.')
+  p.add_argument('--max_deadline_s', type=float, default=600.0)
+  p.add_argument('--io_timeout_s', type=float, default=20.0,
+                 help='Per-socket read/write timeout; a slow-drip or '
+                 'half-dead client is cut after this long.')
+  p.add_argument('--on_request_error', default='ccs-fallback',
+                 choices=['skip', 'ccs-fallback'],
+                 help='Policy for a request whose windows fail the '
+                 'model stage twice (shared pack + isolation retry).')
+  p.add_argument('--dead_letter', default=None,
+                 help='Append quarantined-request records (with '
+                 'request attribution) to this JSONL sidecar.')
+  p.add_argument('--compilation_cache_dir', default=None,
+                 help='Persistent JAX compilation cache: restarts skip '
+                 'the jit compile, so /readyz flips in seconds.')
+  p.add_argument('--random_init', action='store_true',
+                 help='Serve randomly initialized weights from '
+                 '--config instead of a checkpoint (tests/demos).')
+  p.add_argument('--config', default='transformer_learn_values+test',
+                 help='Model preset for --random_init.')
+
+
 def _add_validate(sub):
   p = sub.add_parser(
       'validate',
@@ -306,6 +361,7 @@ def build_parser() -> argparse.ArgumentParser:
   sub = parser.add_subparsers(dest='command', required=True)
   _add_preprocess(sub)
   _add_run(sub)
+  _add_serve(sub)
   _add_validate(sub)
   _add_train(sub)
   _add_distill(sub)
@@ -378,6 +434,77 @@ def _dispatch(args) -> int:
       with open(args.report, 'w') as f:
         f.write(text + '\n')
     return 0 if report['ok'] else 1
+
+  if args.command == 'serve':
+    import json
+
+    from deepconsensus_tpu.calibration import lib as calibration_lib
+    from deepconsensus_tpu.inference import runner as runner_lib
+    from deepconsensus_tpu.models import config as config_lib
+    from deepconsensus_tpu.serve import server as server_lib
+    from deepconsensus_tpu.serve.service import ServeOptions
+
+    if args.compilation_cache_dir:
+      import jax
+
+      jax.config.update(
+          'jax_compilation_cache_dir', args.compilation_cache_dir)
+      jax.config.update(
+          'jax_persistent_cache_min_compile_time_secs', 0.0)
+    dc_cal = args.dc_calibration
+    if dc_cal is None and args.checkpoint:
+      params_json = config_lib.read_params_from_json(args.checkpoint)
+      dc_cal = params_json.get('dc_calibration', 'skip') or 'skip'
+    options = runner_lib.InferenceOptions(
+        batch_size=args.batch_size,
+        dispatch_depth=args.dispatch_depth,
+        min_length=args.min_length,
+        min_quality=args.min_quality,
+        skip_windows_above=args.skip_windows_above,
+        max_base_quality=args.max_base_quality,
+        dc_calibration_values=calibration_lib.parse_calibration_string(
+            dc_cal or 'skip'),
+        ccs_calibration_values=calibration_lib.parse_calibration_string(
+            args.ccs_calibration),
+    )
+    if args.random_init:
+      import jax
+      import jax.numpy as jnp
+
+      from deepconsensus_tpu.models import model as model_lib
+
+      params = config_lib.get_config(args.config)
+      config_lib.finalize_params(params, is_training=False)
+      variables = model_lib.get_model(params).init(
+          jax.random.PRNGKey(0),
+          jnp.zeros((1, params.total_rows, params.max_length, 1)))
+      runner = runner_lib.ModelRunner(params, variables, options)
+    elif args.checkpoint:
+      runner = runner_lib.ModelRunner.from_checkpoint(
+          args.checkpoint, options)
+    else:
+      raise ValueError('serve needs --checkpoint or --random_init')
+    options.max_passes = runner.params.max_passes
+    options.max_length = runner.params.max_length
+    options.use_ccs_bq = runner.params.use_ccs_bq
+    serve_options = ServeOptions(
+        max_pending=args.max_pending,
+        admit_queue_depth=args.admit_queue_depth,
+        max_windows_per_request=args.max_windows_per_request,
+        max_body_bytes=args.max_body_mb << 20,
+        default_deadline_s=args.default_deadline_s,
+        max_deadline_s=args.max_deadline_s,
+        io_timeout_s=args.io_timeout_s,
+        on_request_error=args.on_request_error,
+        dead_letter_path=args.dead_letter,
+    )
+    stats = server_lib.serve_main(
+        runner, options, serve_options,
+        host=args.host, port=args.port,
+        ready_fn=lambda info: print(json.dumps(info), flush=True))
+    print(json.dumps({'event': 'drained', **stats}, default=str),
+          flush=True)
+    return 0 if stats.get('drained') else 1
 
   if args.command == 'run':
     from deepconsensus_tpu.calibration import lib as calibration_lib

@@ -89,6 +89,66 @@ class CorruptInputError(IOError):
     self.recoverable = recoverable
 
 
+class ServeRejection(RuntimeError):
+  """Base for typed `dctpu serve` admission rejections. Carries an HTTP
+  status so the server layer maps taxonomy -> wire code without
+  parsing messages; `kind` feeds the shared transient/permanent
+  classification (clients retry transient rejections with backoff)."""
+
+  http_status = 500
+
+  @property
+  def kind(self) -> str:
+    return classify_error(str(self))
+
+
+class BackpressureError(ServeRejection):
+  """Admission queue full: the service sheds load instead of growing
+  without bound (429-style). Message embeds RESOURCE_EXHAUSTED so
+  classify_error reports transient — retry after backoff."""
+
+  http_status = 429
+
+  def __init__(self, detail: str):
+    super().__init__(f'RESOURCE_EXHAUSTED: {detail}')
+
+
+class DrainingError(ServeRejection):
+  """Service received SIGTERM and stopped admitting; in-flight work is
+  finishing. Transient (UNAVAILABLE): retry against another replica."""
+
+  http_status = 503
+
+  def __init__(self, detail: str = 'service is draining'):
+    super().__init__(f'UNAVAILABLE: {detail}')
+
+
+class DeadlineExceededError(ServeRejection):
+  """Per-request deadline elapsed before the result was ready; the
+  request's remaining windows were cancelled and its packer slots
+  reclaimed. Transient marker by construction (DEADLINE_EXCEEDED)."""
+
+  http_status = 504
+
+  def __init__(self, detail: str):
+    super().__init__(f'DEADLINE_EXCEEDED: {detail}')
+
+
+class BadRequestError(ServeRejection):
+  """Malformed request payload (undecodable npz, missing fields, shape
+  mismatch against the loaded model). Permanent: no transient markers,
+  so clients must not retry the same bytes."""
+
+  http_status = 400
+
+
+class RequestTooLargeError(BadRequestError):
+  """Request body exceeds the configured byte/window caps — rejected
+  before decode, so an oversized body can't balloon server memory."""
+
+  http_status = 413
+
+
 class CrashLoopError(RuntimeError):
   """Raised by run_training_with_retry when restarts stop making
   progress: the same resume step across K consecutive transient
@@ -182,6 +242,16 @@ ENV_NAN_AT_STEP = 'DCTPU_FAULT_NAN_AT_STEP'
 ENV_SIGTERM_AT_STEP = 'DCTPU_FAULT_SIGTERM_AT_STEP'
 ENV_KILL_TRAIN_AT_STEP = 'DCTPU_FAULT_KILL_TRAIN_AT_STEP'
 ENV_KILL_SHARD_READER = 'DCTPU_FAULT_KILL_SHARD_READER'
+# Serve-path hooks. ENV_POISON_WINDOW names a ZMW substring: the serve
+# triage stage poisons that request's pack so the model stage fails for
+# it (isolation retry -> quarantine path). ENV_SERVE_CLIENT_FAULT makes
+# the *client* (scripts/inject_faults.py serve_client / ServeClient)
+# misbehave on the wire: one of disconnect|garbage|oversized|slowloris,
+# scoped to ZMW names containing ENV_SERVE_CLIENT_FAULT_ZMW (default:
+# every request).
+ENV_POISON_WINDOW = 'DCTPU_FAULT_POISON_WINDOW'
+ENV_SERVE_CLIENT_FAULT = 'DCTPU_FAULT_SERVE_CLIENT'
+ENV_SERVE_CLIENT_FAULT_ZMW = 'DCTPU_FAULT_SERVE_CLIENT_ZMW'
 
 # Hooks that already fired in this process (consume-once semantics:
 # after a NaN-sentinel rollback the training loop passes the same step

@@ -1,0 +1,353 @@
+"""ConsensusEngine: the shared window -> consensus model stage.
+
+The model stage of inference (triage -> pack -> dispatch -> finalize)
+used to live entangled with BAM-pipeline concerns inside
+inference/runner.py, which made every new consumer — sharded inference,
+`dctpu serve`, variable-length workloads — re-touch the same 600-line
+file (ROADMAP item 5). This module extracts it behind a narrow
+interface:
+
+  engine = ConsensusEngine(runner, options, deliver=..., on_pack_failure=...)
+  engine.submit(raw_windows, tickets)   # featurized windows in
+  engine.flush()                        # end of input
+  # finalized uint8 (ids, quals) rows come back through deliver()
+
+* `tickets` are opaque, one per submitted window; the engine never
+  inspects them. deliver(ticket, ids_u8, quals_u8) fires once per
+  window as its pack finalizes (same thread as submit/flush).
+* The engine owns the cross-batch `_WindowPacker` (full fixed-shape
+  packs cut across submissions, pad only on flush), the dispatch depth
+  (packs in flight on the device), and — through the ModelRunner and
+  its params — the fused-Pallas vs XLA path choice
+  (`use_fused_hotpath`, models/model.py `_fused_hotpath_eligible`).
+* A pack that fails to dispatch or finalize routes its tickets to
+  on_pack_failure(tickets, pack_seq, error); without the callback the
+  error propagates (fail-fast).
+
+Two thin clients consume it: the batch CLI pipeline
+(inference/runner.py run_inference) and the resident service
+(deepconsensus_tpu/serve/). The engine is deliberately NOT thread-safe:
+each client drives it from a single model-loop thread.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.preprocess.pileup import row_indices
+from deepconsensus_tpu.utils import phred
+
+Ticket = Any
+DeliverFn = Callable[[Ticket, np.ndarray, np.ndarray], None]
+PackFailureFn = Callable[[Sequence[Ticket], int, BaseException], None]
+
+
+# ----------------------------------------------------------------------
+# Window triage (shared by the batch pipeline and the serve path)
+
+
+def triage_windows(
+    feature_dicts: List[Dict[str, Any]],
+    options,
+    counter: collections.Counter,
+) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+  """Splits windows into (model, skip) per overflow/quality rules
+  (reference: quick_inference.py:653-678)."""
+  to_model: List[Dict[str, Any]] = []
+  to_skip: List[Dict[str, Any]] = []
+  for fd in feature_dicts:
+    if fd['overflow']:
+      to_skip.append(fd)
+      counter['n_windows_overflow_skipped'] += 1
+      continue
+    if options.skip_windows_above:
+      avg_q = phred.avg_phred(fd['ccs_base_quality_scores'])
+      # Strictly above, matching the reference (quick_inference.py:671).
+      if avg_q > options.skip_windows_above:
+        to_skip.append(fd)
+        counter['n_windows_quality_skipped'] += 1
+        continue
+    to_model.append(fd)
+    counter['n_windows_to_model'] += 1
+  return to_model, to_skip
+
+
+def ccs_quals_array(bq_scores, options) -> np.ndarray:
+  """CCS base qualities -> emitted phred uint8 (calibration, cap at
+  max_base_quality, floor at 0) — the quality half of a skipped-window
+  CCS adoption without the string round-trip."""
+  quals = np.asarray(bq_scores)
+  if options.ccs_calibration_values.enabled:
+    quals = calibration_lib.calibrate_quality_scores(
+        quals, options.ccs_calibration_values
+    )
+  quals = np.minimum(quals, options.max_base_quality).astype(np.int32)
+  return np.maximum(quals, 0).astype(np.uint8)
+
+
+def skipped_window_arrays(
+    feature_dict: Dict[str, Any], options
+) -> Tuple[np.ndarray, np.ndarray]:
+  """Array-native skipped-window CCS adoption: (vocab ids uint8 [L],
+  phred uint8 [L]) adopted from the draft CCS. Copies out of the
+  feature tensor, so any backing shm segment can be released."""
+  rows = feature_dict['subreads']
+  ccs_range = row_indices(options.max_passes, options.use_ccs_bq)[4]
+  ids = rows[ccs_range[0], :, 0].astype(np.uint8)
+  return ids, ccs_quals_array(
+      feature_dict['ccs_base_quality_scores'], options)
+
+
+# ----------------------------------------------------------------------
+# Cross-batch window packer
+
+
+class _WindowPacker:
+  """Cross-batch window packer feeding the fixed-shape compiled forward.
+
+  Formatted model-input rows accumulate across submissions; full
+  batch_size packs are cut and dispatched as soon as they exist, so in
+  steady state the forward never runs padded and the dispatch pipeline
+  never drains at submission seams (only the end-of-input tail pads).
+  Up to dispatch_depth packs stay in flight; draining the oldest hands
+  its (ids, quals) rows to deliver(), one call per ticket.
+
+  A pack that fails to dispatch or finalize is routed to
+  on_pack_failure(tickets, pack_seq, error) — ticket bookkeeping plus
+  any quarantine policy live with the caller.
+  """
+
+  def __init__(self, runner, options, timing_rows: List[Dict[str, Any]],
+               on_pack_failure: PackFailureFn, deliver: DeliverFn):
+    self._runner = runner
+    self._batch = options.batch_size
+    self._depth = max(1, options.dispatch_depth)
+    self._timing_rows = timing_rows
+    self._on_pack_failure = on_pack_failure
+    self._deliver = deliver
+    self._rows: List[np.ndarray] = []
+    self._tickets: List[Ticket] = []
+    self._buffered = 0
+    self._in_flight: 'collections.deque' = collections.deque()
+    self._poisoned: set = set()
+    self.n_packs = 0
+    self.n_pack_rows = 0
+    self.n_pad_rows = 0
+    self.model_wall = 0.0
+
+  def add(self, rows: np.ndarray, tickets: Sequence[Ticket]) -> None:
+    """Buffers one submission's formatted model rows ([k, R, L, 1],
+    aligned with tickets) and dispatches every full pack now cuttable."""
+    self._rows.append(rows)
+    self._tickets.extend(tickets)
+    self._buffered += len(rows)
+    self._cut_packs(flush=False)
+
+  def poison(self, ticket: Ticket) -> None:
+    """Fault injection: the pack containing this ticket fails at
+    dispatch (simulates a window payload that breaks the model stage —
+    DCTPU_FAULT_POISON_WINDOW)."""
+    self._poisoned.add(id(ticket))
+
+  def _cut_packs(self, flush: bool) -> None:
+    while self._buffered >= self._batch or (flush and self._buffered):
+      if len(self._rows) > 1:
+        self._rows = [np.concatenate(self._rows)]
+      buf = self._rows[0]
+      n = min(self._batch, self._buffered)
+      pack, rest = buf[:n], buf[n:]
+      self._rows = [rest] if len(rest) else []
+      tickets = self._tickets[:n]
+      del self._tickets[:n]
+      self._buffered -= n
+      self._dispatch(pack, tickets)
+
+  def _dispatch(self, pack: np.ndarray, tickets: List[Ticket]) -> None:
+    seq = self.n_packs
+    self.n_packs += 1
+    self.n_pack_rows += len(pack)
+    self.n_pad_rows += self._batch - len(pack)
+    try:
+      if self._poisoned:
+        hit = [t for t in tickets if id(t) in self._poisoned]
+        if hit:
+          for t in hit:
+            self._poisoned.discard(id(t))
+          raise RuntimeError(
+              'injected poison window payload '
+              f'({faults_lib.ENV_POISON_WINDOW}; {len(hit)} window(s) '
+              f'in pack {seq})')
+      handle = self._runner.dispatch(pack)
+    except Exception as e:
+      self._on_pack_failure(tickets, seq, e)
+      return
+    self._in_flight.append((handle, tickets, seq))
+    while len(self._in_flight) > self._depth:
+      self._drain_one()
+
+  def _drain_one(self) -> None:
+    handle, tickets, seq = self._in_flight.popleft()
+    t0 = time.time()
+    try:
+      pred_ids, quality = self._runner.finalize(handle)
+    except Exception as e:
+      self._on_pack_failure(tickets, seq, e)
+      return
+    # uint8 transport into the stitch plane (values are 0..4 / 0..93).
+    ids_u8 = pred_ids.astype(np.uint8)
+    quals_u8 = quality.astype(np.uint8)
+    elapsed = time.time() - t0
+    self.model_wall += elapsed
+    for ticket, row_ids, row_quals in zip(tickets, ids_u8, quals_u8):
+      self._deliver(ticket, row_ids, row_quals)
+    self._timing_rows.append(dict(
+        stage='run_model', runtime=elapsed, n_zmws=0,
+        n_examples=len(tickets), n_subreads=0))
+
+  def flush(self, drain: bool = True) -> None:
+    """Cuts the sub-batch tail as a final (padded) pack; with drain,
+    also resolves every in-flight pack (end of input)."""
+    self._cut_packs(flush=True)
+    while drain and self._in_flight:
+      self._drain_one()
+
+  @property
+  def has_work(self) -> bool:
+    return bool(self._buffered or self._in_flight)
+
+
+# ----------------------------------------------------------------------
+# The engine
+
+
+def _raise_pack_failure(tickets, pack_seq: int, error: BaseException):
+  del tickets, pack_seq
+  raise error
+
+
+class ConsensusEngine:
+  """Submit featurized windows, receive finalized uint8 (ids, quals).
+
+  Owns the window packer, the dispatch depth, and (via the ModelRunner
+  / model config) the fused-kernel vs XLA path choice. See the module
+  docstring for the contract; construct via __init__ with an existing
+  ModelRunner or via from_checkpoint.
+  """
+
+  def __init__(self, runner, options, deliver: DeliverFn,
+               on_pack_failure: Optional[PackFailureFn] = None,
+               timing_rows: Optional[List[Dict[str, Any]]] = None):
+    self.runner = runner
+    self.options = options
+    self.timing_rows = timing_rows if timing_rows is not None else []
+    self._packer = _WindowPacker(
+        runner, options, self.timing_rows,
+        on_pack_failure or _raise_pack_failure, deliver)
+
+  @classmethod
+  def from_checkpoint(cls, checkpoint_path: str, options,
+                      deliver: DeliverFn,
+                      on_pack_failure: Optional[PackFailureFn] = None,
+                      timing_rows: Optional[List[Dict[str, Any]]] = None,
+                      mesh=None) -> 'ConsensusEngine':
+    from deepconsensus_tpu.inference import runner as runner_lib
+
+    runner = runner_lib.ModelRunner.from_checkpoint(
+        checkpoint_path, options, mesh=mesh)
+    options.max_passes = runner.params.max_passes
+    options.max_length = runner.params.max_length
+    options.use_ccs_bq = runner.params.use_ccs_bq
+    return cls(runner, options, deliver,
+               on_pack_failure=on_pack_failure, timing_rows=timing_rows)
+
+  @property
+  def params(self):
+    return self.runner.params
+
+  def submit(self, raw_windows: np.ndarray,
+             tickets: Sequence[Ticket]) -> None:
+    """Feeds featurized window tensors ([k, total_rows, L, 1], one
+    ticket per window) through format -> pack -> dispatch. Full packs
+    dispatch immediately; the tail waits for more windows or flush()."""
+    from deepconsensus_tpu.models import data as data_lib
+
+    if len(raw_windows) != len(tickets):
+      raise ValueError(
+          f'{len(raw_windows)} windows vs {len(tickets)} tickets')
+    if not len(raw_windows):
+      return
+    rows = data_lib.format_rows_batch(
+        np.asarray(raw_windows), self.runner.params)
+    self._packer.add(rows, list(tickets))
+
+  def submit_formatted(self, rows: np.ndarray,
+                       tickets: Sequence[Ticket]) -> None:
+    """submit() for rows already through data.format_rows_batch (the
+    serve retry path re-dispatches without re-formatting)."""
+    if len(rows) != len(tickets):
+      raise ValueError(f'{len(rows)} rows vs {len(tickets)} tickets')
+    if len(rows):
+      self._packer.add(np.asarray(rows), list(tickets))
+
+  def flush(self, drain: bool = True) -> None:
+    """Cuts the buffered tail as a padded pack; with drain, resolves
+    every in-flight pack (every submitted ticket has been delivered or
+    failed when this returns)."""
+    self._packer.flush(drain=drain)
+
+  def poison_ticket(self, ticket: Ticket) -> None:
+    self._packer.poison(ticket)
+
+  @property
+  def has_work(self) -> bool:
+    """True while any submitted window is still buffered or in flight."""
+    return self._packer.has_work
+
+  @property
+  def n_packs(self) -> int:
+    return self._packer.n_packs
+
+  @property
+  def n_pack_rows(self) -> int:
+    return self._packer.n_pack_rows
+
+  @property
+  def n_pad_rows(self) -> int:
+    return self._packer.n_pad_rows
+
+  @property
+  def model_wall(self) -> float:
+    return self._packer.model_wall
+
+  def stats(self) -> Dict[str, Any]:
+    return {
+        'n_model_packs': self.n_packs,
+        'n_model_pack_rows': self.n_pack_rows,
+        'n_model_pad_rows': self.n_pad_rows,
+        'model_wall_s': round(self.model_wall, 3),
+    }
+
+  def predict_windows(
+      self, raw_windows: np.ndarray
+  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Synchronous convenience: featurized windows -> (ids, quals),
+    in submission order. Flushes the pipeline, so only for tools/tests
+    — streaming callers use submit()/flush() with tickets."""
+    results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    save = self._packer._deliver
+    try:
+      self._packer._deliver = (
+          lambda ticket, ids, quals: results.__setitem__(
+              ticket, (ids, quals)))
+      self.submit(raw_windows, list(range(len(raw_windows))))
+      self.flush()
+    finally:
+      self._packer._deliver = save
+    ids = np.stack([results[i][0] for i in range(len(raw_windows))])
+    quals = np.stack([results[i][1] for i in range(len(raw_windows))])
+    return ids, quals

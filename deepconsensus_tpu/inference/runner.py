@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.inference import engine as engine_lib
 from deepconsensus_tpu.inference import faults
 from deepconsensus_tpu.io import bam as bam_lib
 from deepconsensus_tpu.models import config as config_lib
@@ -554,30 +555,15 @@ def process_skipped_window(
   )
 
 
-def _ccs_quals_array(bq_scores, options: InferenceOptions) -> np.ndarray:
-  """CCS base qualities -> emitted phred uint8 (calibration, cap at
-  max_base_quality, floor at 0) — the quality half of
-  process_skipped_window without the string round-trip."""
-  quals = np.asarray(bq_scores)
-  if options.ccs_calibration_values.enabled:
-    quals = calibration_lib.calibrate_quality_scores(
-        quals, options.ccs_calibration_values
-    )
-  quals = np.minimum(quals, options.max_base_quality).astype(np.int32)
-  return np.maximum(quals, 0).astype(np.uint8)
-
-
-def skipped_window_arrays(
-    feature_dict: Dict[str, Any], options: InferenceOptions
-) -> Tuple[np.ndarray, np.ndarray]:
-  """Array-native process_skipped_window: (vocab ids uint8 [L],
-  phred uint8 [L]) adopted from the draft CCS. Copies out of the
-  feature tensor, so the backing shm segment can be released."""
-  rows = feature_dict['subreads']
-  ccs_range = row_indices(options.max_passes, options.use_ccs_bq)[4]
-  ids = rows[ccs_range[0], :, 0].astype(np.uint8)
-  return ids, _ccs_quals_array(
-      feature_dict['ccs_base_quality_scores'], options)
+# The model stage (triage -> pack -> dispatch -> finalize) lives in
+# inference/engine.py as ConsensusEngine; this pipeline is one of its
+# thin clients (the serve daemon is the other). Aliases keep the
+# historical runner.py names importable.
+_ccs_quals_array = engine_lib.ccs_quals_array
+skipped_window_arrays = engine_lib.skipped_window_arrays
+_triage_windows = engine_lib.triage_windows
+_WindowPacker = engine_lib._WindowPacker
+ConsensusEngine = engine_lib.ConsensusEngine
 
 
 class _MolState:
@@ -667,125 +653,6 @@ class _BatchState:
   @property
   def complete(self) -> bool:
     return self.featurized and self.pending == 0
-
-
-class _WindowPacker:
-  """Cross-batch window packer feeding the fixed-shape compiled forward.
-
-  Formatted model-input rows accumulate across featurize batches; full
-  batch_size packs are cut and dispatched as soon as they exist, so in
-  steady state the forward never runs padded and the dispatch pipeline
-  never drains at featurize-batch seams (only the end-of-input tail
-  pads). Up to dispatch_depth packs stay in flight; draining the oldest
-  scatters its (ids, quals) rows back to their molecules via slots.
-
-  A pack that fails to dispatch or finalize is routed to
-  on_pack_failure(slots, pack_seq, error) — slot bookkeeping plus
-  per-member-molecule quarantine happen there.
-  """
-
-  def __init__(self, runner: ModelRunner, options: InferenceOptions,
-               timing_rows: List[Dict[str, Any]], on_pack_failure):
-    self._runner = runner
-    self._batch = options.batch_size
-    self._depth = max(1, options.dispatch_depth)
-    self._timing_rows = timing_rows
-    self._on_pack_failure = on_pack_failure
-    self._rows: List[np.ndarray] = []
-    self._slots: List[Tuple[_MolState, int]] = []
-    self._buffered = 0
-    self._in_flight: 'collections.deque' = collections.deque()
-    self.n_packs = 0
-    self.n_pack_rows = 0
-    self.n_pad_rows = 0
-    self.model_wall = 0.0
-
-  def add(self, rows: np.ndarray, slots: List[Tuple[_MolState, int]]):
-    """Buffers one featurize batch's formatted model rows ([k, R, L, 1],
-    aligned with slots) and dispatches every full pack now cuttable."""
-    self._rows.append(rows)
-    self._slots.extend(slots)
-    self._buffered += len(rows)
-    self._cut_packs(flush=False)
-
-  def _cut_packs(self, flush: bool) -> None:
-    while self._buffered >= self._batch or (flush and self._buffered):
-      if len(self._rows) > 1:
-        self._rows = [np.concatenate(self._rows)]
-      buf = self._rows[0]
-      n = min(self._batch, self._buffered)
-      pack, rest = buf[:n], buf[n:]
-      self._rows = [rest] if len(rest) else []
-      slots = self._slots[:n]
-      del self._slots[:n]
-      self._buffered -= n
-      self._dispatch(pack, slots)
-
-  def _dispatch(self, pack: np.ndarray, slots) -> None:
-    seq = self.n_packs
-    self.n_packs += 1
-    self.n_pack_rows += len(pack)
-    self.n_pad_rows += self._batch - len(pack)
-    try:
-      handle = self._runner.dispatch(pack)
-    except Exception as e:
-      self._on_pack_failure(slots, seq, e)
-      return
-    self._in_flight.append((handle, slots, seq))
-    while len(self._in_flight) > self._depth:
-      self._drain_one()
-
-  def _drain_one(self) -> None:
-    handle, slots, seq = self._in_flight.popleft()
-    t0 = time.time()
-    try:
-      pred_ids, quality = self._runner.finalize(handle)
-    except Exception as e:
-      self._on_pack_failure(slots, seq, e)
-      return
-    # uint8 transport into the stitch plane (values are 0..4 / 0..93).
-    ids_u8 = pred_ids.astype(np.uint8)
-    quals_u8 = quality.astype(np.uint8)
-    elapsed = time.time() - t0
-    self.model_wall += elapsed
-    for (mol, idx), row_ids, row_quals in zip(slots, ids_u8, quals_u8):
-      mol.set_result(idx, row_ids, row_quals)
-    self._timing_rows.append(dict(
-        stage='run_model', runtime=elapsed, n_zmws=0,
-        n_examples=len(slots), n_subreads=0))
-
-  def flush(self, drain: bool = True) -> None:
-    """Cuts the sub-batch tail as a final (padded) pack; with drain,
-    also resolves every in-flight pack (end of input)."""
-    self._cut_packs(flush=True)
-    while drain and self._in_flight:
-      self._drain_one()
-
-
-def _triage_windows(
-    feature_dicts: List[Dict[str, Any]],
-    options: InferenceOptions,
-    counter: collections.Counter,
-) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
-  """Splits windows into (model, skip) per overflow/quality rules
-  (reference: quick_inference.py:653-678)."""
-  to_model: List[Dict[str, Any]] = []
-  to_skip: List[Dict[str, Any]] = []
-  for fd in feature_dicts:
-    if fd['overflow']:
-      to_skip.append(fd)
-      counter['n_windows_overflow_skipped'] += 1
-      continue
-    if options.skip_windows_above:
-      avg_q = phred.avg_phred(fd['ccs_base_quality_scores'])
-      # Strictly above, matching the reference (quick_inference.py:671).
-      if avg_q > options.skip_windows_above:
-        to_skip.append(fd)
-        counter['n_windows_quality_skipped'] += 1
-        continue
-    to_model.append(fd)
-    counter['n_windows_to_model'] += 1
-  return to_model, to_skip
 
 
 def run_model_on_windows(
@@ -1257,10 +1124,16 @@ def run_inference(
           )
           mol.status = 'adopted' if adopted else 'dropped'
 
-      packer: Optional[_WindowPacker] = None
+      engine: Optional[ConsensusEngine] = None
       if model_mode:
-        packer = _WindowPacker(runner, options, timing_rows,
-                               on_pack_failure)
+        # Tickets are (mol, idx) slots; a delivered row resolves its
+        # molecule's pending window directly.
+        engine = ConsensusEngine(
+            runner, options,
+            deliver=lambda slot, ids, quals: slot[0].set_result(
+                slot[1], ids, quals),
+            on_pack_failure=on_pack_failure,
+            timing_rows=timing_rows)
 
       def ingest_batch(feat) -> None:
         """Main-thread stage: triage a featurize batch, copy what the
@@ -1295,12 +1168,11 @@ def run_inference(
                mol.append_pending(fd['window_pos'], ccs_ids, ccs_bq)))
         if to_model:
           raw = np.stack([fd['subreads'] for fd in to_model])
-          rows = data_lib.format_rows_batch(raw, params)
-          packer.add(rows, slots)
+          engine.submit(raw, slots)
           if not options.pack_across_batches:
             # Compat/debug mode: pad out this batch's tail instead of
             # carrying it into the next featurize batch's pack.
-            packer.flush(drain=False)
+            engine.flush(drain=False)
         feat['windows'] = None
         state.featurized = True
         states.append(state)
@@ -1440,8 +1312,8 @@ def run_inference(
                 f'injected crash after {batches_ingested} batch(es) '
                 f'({faults.ENV_CRASH_AFTER_BATCHES})'
             )
-        if packer is not None:
-          packer.flush()  # end of input: cut the tail pack, drain all
+        if engine is not None:
+          engine.flush()  # end of input: cut the tail pack, drain all
         pop_ready()
         if states:
           raise RuntimeError(
@@ -1457,10 +1329,10 @@ def run_inference(
         thread.join(timeout=30)
         if emit_thread is not None:
           emit_thread.join(timeout=30)
-        if packer is not None:
-          window_counter['n_model_packs'] = packer.n_packs
-          window_counter['n_model_pack_rows'] = packer.n_pack_rows
-          window_counter['n_model_pad_rows'] = packer.n_pad_rows
+        if engine is not None:
+          window_counter['n_model_packs'] = engine.n_packs
+          window_counter['n_model_pack_rows'] = engine.n_pack_rows
+          window_counter['n_model_pad_rows'] = engine.n_pad_rows
         if thread.is_alive():
           # Draining now would race the producer's put(); anything it
           # enqueues after our drain would leak its shm segments.

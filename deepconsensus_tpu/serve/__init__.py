@@ -1,0 +1,19 @@
+"""`dctpu serve`: a resident consensus service on the ConsensusEngine.
+
+Layers (each importable on its own):
+
+* protocol.py — the npz-over-HTTP wire format with byte/window caps
+  enforced before any allocation is trusted.
+* service.py  — ConsensusService: admission control, the single model
+  loop doing continuous batching across concurrent requests, per-
+  request deadlines, pack-failure isolation retries, and per-request
+  quarantine with dead-letter attribution.
+* server.py   — the stdlib ThreadingHTTPServer front end (/v1/polish,
+  /healthz, /readyz, /metricz) and serve_main with SIGTERM drain.
+* client.py   — ServeClient plus the raw-socket fault senders used by
+  scripts/inject_faults.py.
+"""
+from deepconsensus_tpu.serve.service import (  # noqa: F401
+    ConsensusService,
+    ServeOptions,
+)

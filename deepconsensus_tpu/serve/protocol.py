@@ -1,0 +1,158 @@
+"""Wire format for `dctpu serve`: npz request/response bodies.
+
+One POST /v1/polish body carries one molecule's featurized windows
+(the client runs preprocessing; the server owns triage + model +
+stitch so serve output is byte-identical to the batch pipeline's).
+npz keeps the bulk float32 tensors out of JSON and decodes with
+allow_pickle=False, so a request body can never smuggle arbitrary
+objects. Every field is validated against the loaded model's shapes
+BEFORE the request is admitted — an oversized or malformed body is a
+typed 4xx, not server memory growth (same posture as the PR-4 bounded
+decoders).
+
+Request arrays:
+  subreads    float32 [n, total_rows, max_length, 1]
+  window_pos  int64   [n]
+  ccs_bq      int32   [n, max_length]   (draft CCS base qualities)
+  overflow    uint8   [n]
+  name        0-d str (molecule name)
+  meta_json   0-d str (optional: ec / np_num_passes / rq / rg)
+
+Response arrays (application/octet-stream):
+  status      0-d str: ok | fallback | filtered | quarantined
+  seq         uint8 [len]  (ascii bases; empty unless ok/fallback)
+  quals       uint8 [len]  (phred values, not ascii)
+  counters_json  0-d str   (per-request triage/window counters)
+  error       0-d str      (quarantine detail; empty otherwise)
+
+Errors travel as application/json: {"error", "kind", "status"}.
+"""
+from __future__ import annotations
+
+import io
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from deepconsensus_tpu import faults as faults_lib
+
+CONTENT_TYPE = 'application/octet-stream'
+DEADLINE_HEADER = 'X-Dctpu-Deadline-S'
+REQUEST_FIELDS = ('name', 'subreads', 'window_pos', 'ccs_bq', 'overflow')
+_META_KEYS = ('ec', 'np_num_passes', 'rq', 'rg')
+
+
+def encode_request(name: str, subreads: np.ndarray,
+                   window_pos: np.ndarray, ccs_bq: np.ndarray,
+                   overflow: np.ndarray,
+                   meta: Optional[Dict[str, Any]] = None) -> bytes:
+  buf = io.BytesIO()
+  np.savez(
+      buf,
+      name=np.array(str(name)),
+      subreads=np.asarray(subreads, dtype=np.float32),
+      window_pos=np.asarray(window_pos, dtype=np.int64),
+      ccs_bq=np.asarray(ccs_bq, dtype=np.int32),
+      overflow=np.asarray(overflow, dtype=np.uint8),
+      meta_json=np.array(json.dumps(
+          {k: meta[k] for k in _META_KEYS if meta and meta.get(k) is not None}
+      )),
+  )
+  return buf.getvalue()
+
+
+def request_from_features(features) -> bytes:
+  """Builds a request body from one molecule's preprocess window
+  feature dicts (runner.preprocess_zmw output)."""
+  fd0 = features[0]
+  name = fd0['name'] if isinstance(fd0['name'], str) else fd0['name'].decode()
+  return encode_request(
+      name=name,
+      subreads=np.stack([fd['subreads'] for fd in features]),
+      window_pos=np.array([fd['window_pos'] for fd in features]),
+      ccs_bq=np.stack(
+          [np.asarray(fd['ccs_base_quality_scores']) for fd in features]),
+      overflow=np.array([bool(fd['overflow']) for fd in features]),
+      meta={k: fd0.get(k) for k in _META_KEYS},
+  )
+
+
+def decode_request(body: bytes, *, total_rows: int, max_length: int,
+                   max_windows: int) -> Dict[str, Any]:
+  """Parses + validates one request body. Raises BadRequestError (400)
+  on anything malformed and RequestTooLargeError (413) when the window
+  count exceeds the admission cap."""
+  try:
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+      missing = [f for f in REQUEST_FIELDS if f not in z.files]
+      if missing:
+        raise faults_lib.BadRequestError(
+            f'request missing field(s): {missing}')
+      name = str(z['name'])
+      subreads = z['subreads']
+      window_pos = z['window_pos']
+      ccs_bq = z['ccs_bq']
+      overflow = z['overflow']
+      meta = json.loads(str(z['meta_json'])) if 'meta_json' in z.files else {}
+  except faults_lib.BadRequestError:
+    raise
+  except Exception as e:  # zip/npz framing, bad JSON, disallowed pickle
+    raise faults_lib.BadRequestError(
+        f'undecodable request body: {type(e).__name__}: {e}') from e
+  n = len(subreads)
+  if n < 1:
+    raise faults_lib.BadRequestError('request carries zero windows')
+  if n > max_windows:
+    raise faults_lib.RequestTooLargeError(
+        f'{n} windows exceeds max_windows_per_request={max_windows}')
+  if subreads.shape[1:] != (total_rows, max_length, 1):
+    raise faults_lib.BadRequestError(
+        f'subreads shape {subreads.shape} does not match the loaded '
+        f'model: expected [n, {total_rows}, {max_length}, 1]')
+  if window_pos.shape != (n,) or overflow.shape != (n,):
+    raise faults_lib.BadRequestError(
+        'window_pos/overflow must be [n] aligned with subreads')
+  if ccs_bq.shape != (n, max_length):
+    raise faults_lib.BadRequestError(
+        f'ccs_bq shape {ccs_bq.shape} != [n, {max_length}]')
+  if not np.isfinite(subreads).all():
+    raise faults_lib.BadRequestError('subreads contains non-finite values')
+  if not isinstance(meta, dict):
+    raise faults_lib.BadRequestError('meta_json must encode an object')
+  return {
+      'name': name,
+      'subreads': subreads.astype(np.float32, copy=False),
+      'window_pos': window_pos.astype(np.int64, copy=False),
+      'ccs_bq': ccs_bq.astype(np.int32, copy=False),
+      'overflow': overflow.astype(bool, copy=False),
+      'meta': tuple(meta.get(k) for k in _META_KEYS),
+  }
+
+
+def encode_response(status: str, seq: bytes = b'',
+                    quals: Optional[np.ndarray] = None,
+                    counters: Optional[Dict[str, Any]] = None,
+                    error: str = '') -> bytes:
+  buf = io.BytesIO()
+  np.savez(
+      buf,
+      status=np.array(status),
+      seq=np.frombuffer(seq, dtype=np.uint8),
+      quals=(np.asarray(quals, dtype=np.uint8) if quals is not None
+             else np.zeros(0, dtype=np.uint8)),
+      counters_json=np.array(json.dumps(counters or {})),
+      error=np.array(error[:4000]),
+  )
+  return buf.getvalue()
+
+
+def decode_response(body: bytes) -> Dict[str, Any]:
+  with np.load(io.BytesIO(body), allow_pickle=False) as z:
+    return {
+        'status': str(z['status']),
+        'seq': z['seq'].tobytes(),
+        'quals': np.array(z['quals']),
+        'counters': json.loads(str(z['counters_json'])),
+        'error': str(z['error']),
+    }

@@ -6,6 +6,9 @@
 #   ./run_all_tests.sh resilience  # fault-injection suite only
 #   ./run_all_tests.sh io-fuzz     # corruption-fuzz harness only (deep
 #                                  # sweep, 2000 mutants per format)
+#   ./run_all_tests.sh serve       # `dctpu serve` stage only (engine
+#                                  # boundary, service fault drills,
+#                                  # SIGTERM-under-load drain)
 #
 # Two-tier structure: the `slow` marker covers the heavy interpret-mode
 # Pallas golden sweeps (wavefront train/VJP/unroll, banded-attention
@@ -33,6 +36,10 @@ fi
 
 if [[ "${1:-}" == "io-fuzz" ]]; then
   exec scripts/run_resilience.sh --io-fuzz
+fi
+
+if [[ "${1:-}" == "serve" ]]; then
+  exec scripts/run_resilience.sh --serve
 fi
 
 python -m pytest tests/ -q

@@ -30,9 +30,19 @@ Training-side tools:
              mismatch vs the integrity manifest) or delete its
              manifest (simulates a save that never committed).
 
-Worker SIGKILL, NaN-batch, preemption-signal, and consumer-crash
-injection are driven by env vars read by deepconsensus_tpu/faults.py;
-this script documents them in --help.
+Serve-side tools (`dctpu serve` robustness drills):
+
+* serve_client — adversarial clients against a running daemon:
+             disconnect (claim full length, send half, RST),
+             garbage (well-framed HTTP, non-npz body), oversized
+             (absurd Content-Length, no body), slowloris (drip one
+             byte per interval). The daemon must shed each with a
+             typed rejection while concurrent well-formed clients
+             keep completing.
+
+Worker SIGKILL, NaN-batch, preemption-signal, consumer-crash, poison
+window, and client self-sabotage injection are driven by env vars read
+by deepconsensus_tpu/faults.py; this script documents them in --help.
 """
 from __future__ import annotations
 
@@ -408,6 +418,15 @@ def main(argv: Optional[List[str]] = None) -> int:
           '  DCTPU_FAULT_KILL_SHARD_READER=<substr>  SIGKILL the shard '
           'reader that opens a shard path containing substr '
           '(token-gated)\n'
+          '  DCTPU_FAULT_POISON_WINDOW=<substr>  `dctpu serve`: a '
+          'request whose ZMW name contains substr carries a poison '
+          'window that fails its model pack (and its isolation retry) '
+          '-> quarantine with request attribution\n'
+          '  DCTPU_FAULT_SERVE_CLIENT=<mode>   ServeClient.polish() '
+          'misbehaves on the wire instead of sending (modes: '
+          'disconnect, garbage, oversized, slowloris)\n'
+          '  DCTPU_FAULT_SERVE_CLIENT_ZMW=<substr>  scope the client '
+          'sabotage to molecules whose name contains substr\n'
       ),
   )
   sub = parser.add_subparsers(dest='command', required=True)
@@ -467,6 +486,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                  default='truncate')
   p.add_argument('--fraction', type=float, default=0.5)
 
+  p = sub.add_parser('serve_client',
+                     help='Adversarial client against a running '
+                     '`dctpu serve` daemon.')
+  p.add_argument('--host', default='127.0.0.1')
+  p.add_argument('--port', type=int, default=8764)
+  p.add_argument('--mode', required=True,
+                 choices=('disconnect', 'garbage', 'oversized',
+                          'slowloris'))
+  p.add_argument('--n', type=int, default=1, help='Repeat count.')
+  p.add_argument('--duration_s', type=float, default=30.0,
+                 help='slowloris: how long to keep dripping.')
+  p.add_argument('--interval_s', type=float, default=0.5,
+                 help='slowloris: seconds between dripped bytes.')
+
   args = parser.parse_args(argv)
   if args.command == 'synth':
     subreads, ccs = write_synthetic_zmw_bams(
@@ -506,6 +539,34 @@ def main(argv: Optional[List[str]] = None) -> int:
   if args.command == 'corrupt_ckpt':
     print(corrupt_checkpoint(args.ckpt, mode=args.mode,
                              fraction=args.fraction))
+    return 0
+  if args.command == 'serve_client':
+    from deepconsensus_tpu.serve import client as client_lib
+    from deepconsensus_tpu.serve import protocol
+
+    # A small but well-formed request body for the half-send; the
+    # server never decodes it, so the shapes are arbitrary.
+    body = protocol.encode_request(
+        'inject/0/ccs',
+        np.zeros((1, 9, 8, 1), dtype=np.float32),
+        np.zeros(1, dtype=np.int64),
+        np.zeros((1, 8), dtype=np.int32),
+        np.zeros(1, dtype=np.uint8))
+    for i in range(args.n):
+      if args.mode == 'disconnect':
+        sent = client_lib.send_disconnect(args.host, args.port, body)
+        print(f'[{i}] disconnect: sent {sent}/{len(body)} claimed bytes')
+      elif args.mode == 'garbage':
+        status = client_lib.send_garbage(args.host, args.port, seed=i)
+        print(f'[{i}] garbage: HTTP {status}')
+      elif args.mode == 'oversized':
+        status = client_lib.send_oversized(args.host, args.port)
+        print(f'[{i}] oversized: HTTP {status}')
+      elif args.mode == 'slowloris':
+        survived = client_lib.send_slowloris(
+            args.host, args.port, duration_s=args.duration_s,
+            interval_s=args.interval_s)
+        print(f'[{i}] slowloris: connection survived {survived:.1f}s')
     return 0
   return 2
 

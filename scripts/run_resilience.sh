@@ -16,6 +16,10 @@
 #   scripts/run_resilience.sh             # full resilience suite
 #   scripts/run_resilience.sh --io-fuzz   # corruption-fuzz stage only,
 #                                         # at 2000 mutants per format
+#   scripts/run_resilience.sh --serve     # `dctpu serve` stage only:
+#                                         # engine boundary + service
+#                                         # fault drills + the real
+#                                         # SIGTERM-under-load drain
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -27,6 +31,16 @@ if [[ "${1:-}" == "--io-fuzz" ]]; then
     DCTPU_FUZZ_MUTANTS="${DCTPU_FUZZ_MUTANTS:-2000}" \
     python -m pytest tests/test_io_fuzz.py tests/test_native.py \
     -q -m resilience --continue-on-collection-errors "$@"
+fi
+
+if [[ "${1:-}" == "--serve" ]]; then
+  shift
+  # The serving stage in isolation, slow tests included (the
+  # subprocess SIGTERM drain is the acceptance demo).
+  exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_engine.py tests/test_serve.py \
+    tests/test_window_packer.py \
+    -q --continue-on-collection-errors "$@"
 fi
 
 timeout -k 10 600 env JAX_PLATFORMS=cpu \

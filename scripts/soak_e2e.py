@@ -10,14 +10,26 @@ soak verdict: sustained ZMW/s, first-vs-last-quartile throughput ratio
 (flatness), peak RSS, peak shm segments.
 
   python scripts/soak_e2e.py --copies 500 --out_dir /root/soak_r5
+
+Serve mode (--serve N): one `dctpu serve` daemon, N concurrent clients
+hammering /v1/polish with featurized synthetic molecules. Verifies
+every concurrent result byte-identical to a solo (single-client)
+baseline — zero cross-request leaks under continuous batching — then
+SIGTERMs the daemon under residual load and checks the graceful drain.
+Verdict line reports client-observed p50/p99 latency and the daemon's
+own /metricz counters.
+
+  python scripts/soak_e2e.py --serve 8 --serve_rounds 20
 """
 import argparse
 import gzip
 import json
 import os
+import signal
 import struct
 import subprocess
 import sys
+import threading
 import time
 
 TESTDATA = '/root/reference/deepconsensus/testdata/human_1m'
@@ -116,6 +128,160 @@ def count_fastq_records(path: str) -> int:
   return n // 4
 
 
+def serve_soak(args) -> int:
+  """Multi-client soak of a resident `dctpu serve` daemon."""
+  sys.path.insert(0, os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+  from deepconsensus_tpu.inference import runner as runner_lib
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.preprocess import (FeatureLayout,
+                                            create_proc_feeder)
+  from deepconsensus_tpu.serve.client import ServeClient, ServeClientError
+  from scripts.inject_faults import write_synthetic_zmw_bams
+
+  os.makedirs(args.out_dir, exist_ok=True)
+  synth_dir = os.path.join(args.out_dir, f'serve_synth_{args.serve_zmws}')
+  if not os.path.isdir(synth_dir):
+    write_synthetic_zmw_bams(synth_dir, n_zmws=args.serve_zmws,
+                             n_subreads=5, seq_len=600)
+  sub_bam = os.path.join(synth_dir, 'subreads_to_ccs.bam')
+  ccs_bam = os.path.join(synth_dir, 'ccs.bam')
+
+  # Featurize every molecule once in the parent; clients re-send the
+  # same feature payloads all soak long (the daemon does triage + model
+  # + stitch per request).
+  config = 'transformer_learn_values+test'
+  params = config_lib.get_config(config)
+  config_lib.finalize_params(params, is_training=False)
+  options = runner_lib.InferenceOptions(min_quality=0)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  layout = FeatureLayout(
+      max_passes=options.max_passes, max_length=options.max_length,
+      use_ccs_bq=options.use_ccs_bq)
+  feeder, _ = create_proc_feeder(
+      subreads_to_ccs=sub_bam, ccs_bam=ccs_bam, layout=layout,
+      ins_trim=options.ins_trim)
+  molecules = []
+  for zmw_input in feeder():
+    features, _ = runner_lib.preprocess_zmw(zmw_input, options)
+    if features:
+      molecules.append(features)
+  print(f'featurized {len(molecules)} molecules from {synth_dir}',
+        flush=True)
+
+  env = dict(os.environ)
+  env['PYTHONPATH'] = '/root/repo:' + env.get('PYTHONPATH', '')
+  env['JAX_PLATFORMS'] = env.get('JAX_PLATFORMS', 'cpu')
+  proc = subprocess.Popen(
+      [sys.executable, '-m', 'deepconsensus_tpu.cli', 'serve',
+       '--random_init', '--config', config, '--port', '0',
+       '--min_quality', '0',
+       '--batch_size', str(args.serve_batch_size)],
+      env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+      text=True)
+  t0 = time.time()
+  ready = json.loads(proc.stdout.readline())
+  port = ready['port']
+  print(json.dumps(ready), flush=True)
+
+  # Solo baseline: one client, one pass, no concurrency.
+  solo_client = ServeClient(port=port, timeout=180)
+  solo = {}
+  for features in molecules:
+    resp = solo_client.polish_features(features)
+    name = features[0]['name']
+    name = name if isinstance(name, str) else name.decode()
+    solo[name] = (resp['status'], resp['seq'],
+                  None if resp['quals'] is None
+                  else resp['quals'].tobytes())
+
+  lock = threading.Lock()
+  latencies = []
+  mismatches = []
+  errors = []
+  n_ok = [0]
+
+  def worker(wid):
+    client = ServeClient(port=port, timeout=180)
+    start = wid % max(1, len(molecules))
+    rotated = molecules[start:] + molecules[:start]
+    for r in range(args.serve_rounds):
+      for features in rotated:
+        name = features[0]['name']
+        name = name if isinstance(name, str) else name.decode()
+        t_req = time.monotonic()
+        try:
+          resp = client.polish_features(features)
+        except ServeClientError as e:
+          with lock:
+            errors.append(f'{name}: HTTP {e.status}')
+          continue
+        except OSError:
+          return  # daemon gone (post-drain) — expected for the tail burst
+        dt = time.monotonic() - t_req
+        got = (resp['status'], resp['seq'],
+               None if resp['quals'] is None
+               else resp['quals'].tobytes())
+        with lock:
+          latencies.append(dt)
+          if got != solo[name]:
+            mismatches.append(name)
+          else:
+            n_ok[0] += 1
+
+  threads = [threading.Thread(target=worker, args=(w,))
+             for w in range(args.serve)]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join()
+
+  metricz = solo_client.metricz()
+  # Drain under residual load: a last burst of clients is mid-flight
+  # when SIGTERM lands; everything admitted must still complete.
+  tail = [threading.Thread(target=worker, args=(w,))
+          for w in range(min(2, args.serve))]
+  for t in tail:
+    t.start()
+  time.sleep(0.2)
+  proc.send_signal(signal.SIGTERM)
+  rc = proc.wait(timeout=300)
+  for t in tail:
+    t.join(60)
+  drained_line = {}
+  for line in proc.stdout.read().splitlines():
+    if line.startswith('{'):
+      d = json.loads(line)
+      if d.get('event') == 'drained':
+        drained_line = d
+
+  lat = sorted(latencies)
+  verdict = {
+      'soak': 'serve',
+      'rc': rc,
+      'n_clients': args.serve,
+      'n_molecules': len(molecules),
+      'n_requests_verified': n_ok[0],
+      'n_mismatches': len(mismatches),
+      'n_client_errors': len(errors),
+      'p50_s': round(lat[len(lat) // 2], 4) if lat else None,
+      'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4)
+               if lat else None,
+      'daemon_faults': metricz.get('faults', {}),
+      'drained': bool(drained_line.get('drained')),
+      'wall_s': round(time.time() - t0, 1),
+  }
+  print(json.dumps(verdict), flush=True)
+  if mismatches:
+    print(f'MISMATCHED vs solo: {sorted(set(mismatches))[:10]}',
+          flush=True)
+  ok = (rc == 0 and not mismatches and verdict['drained']
+        and n_ok[0] > 0)
+  return 0 if ok else 1
+
+
 def main():
   ap = argparse.ArgumentParser()
   ap.add_argument('--copies', type=int, default=500)
@@ -129,7 +295,21 @@ def main():
                   help='ZMW count for the synthetic fallback when the '
                   'reference testdata is absent (~5.8 ZMW/s on the '
                   '1-core CPU host -> 4000 gives a >10 min soak)')
+  ap.add_argument('--serve', type=int, default=0, metavar='N',
+                  help='Serve mode: soak one `dctpu serve` daemon with '
+                  'N concurrent clients instead of the batch pipeline.')
+  ap.add_argument('--serve_rounds', type=int, default=10,
+                  help='Serve mode: polish passes over the molecule '
+                  'set per client.')
+  ap.add_argument('--serve_zmws', type=int, default=24,
+                  help='Serve mode: synthetic molecule count.')
+  ap.add_argument('--serve_batch_size', type=int, default=64,
+                  help='Serve mode: daemon pack size (every pack pads '
+                  'to this compiled shape; keep small on CPU hosts).')
   args = ap.parse_args()
+
+  if args.serve > 0:
+    return serve_soak(args)
 
   os.makedirs(args.out_dir, exist_ok=True)
   # Hosts without the reference testdata fall back to deterministic

@@ -1,0 +1,297 @@
+"""ConsensusEngine boundary tests.
+
+Ports the compile-once smoke (test_perf_smoke.py) and the packer edge
+cases (test_window_packer.py) to the engine's submit/deliver interface,
+and proves the runner refactor behavior-preserving: driving the engine
+directly over a featurized synthetic input reproduces the batch CLI's
+FASTQ byte-for-byte.
+"""
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax._src import test_util as jtu
+
+from deepconsensus_tpu.inference import engine as engine_lib
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.postprocess import stitch
+
+pytestmark = pytest.mark.resilience
+
+BATCH = 8
+STUB_QUAL = 40
+
+
+@pytest.fixture(scope='module')
+def params():
+  p = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(p, is_training=False)
+  return p
+
+
+def _stub_runner(params, batch_size=BATCH, fail_packs=()):
+  """Weightless ModelRunner whose finalize echoes each window's
+  draft-CCS row; packs listed in fail_packs raise at dispatch."""
+  options = runner_lib.InferenceOptions(batch_size=batch_size)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  runner = runner_lib.ModelRunner(params, {}, options)
+  mp = params.max_passes
+  seq = [0]
+
+  def dispatch(rows):
+    pack = seq[0]
+    seq[0] += 1
+    if pack in fail_packs:
+      raise RuntimeError(f'stub failure in pack {pack}')
+    return rows
+
+  def finalize(rows):
+    ids = rows[:, 4 * mp, :, 0].astype(np.int32)
+    return ids, np.full(ids.shape, STUB_QUAL, np.int32)
+
+  runner.dispatch = dispatch
+  runner.finalize = finalize
+  return runner, options
+
+
+def _raw_windows(params, n, seed=0):
+  rng = np.random.default_rng(seed)
+  shape = (n, params.total_rows, params.max_length, 1)
+  return rng.integers(0, 5, size=shape).astype(np.float32)
+
+
+def _collecting_engine(params, batch_size=BATCH, fail_packs=()):
+  runner, options = _stub_runner(params, batch_size, fail_packs)
+  delivered = {}
+  failures = []
+  engine = engine_lib.ConsensusEngine(
+      runner, options,
+      deliver=lambda t, ids, quals: delivered.__setitem__(t, (ids, quals)),
+      on_pack_failure=lambda ts, seq, e: failures.append((list(ts), seq, e)))
+  return engine, delivered, failures
+
+
+# ----------------------------------------------------------------------
+# Compile-once smoke at the engine boundary (port of test_perf_smoke)
+
+
+@pytest.fixture(scope='module')
+def real_engine(params):
+  variables = model_lib.get_model(params).init(
+      jax.random.PRNGKey(0),
+      jnp.zeros((1, params.total_rows, params.max_length, 1)))
+  options = runner_lib.InferenceOptions(batch_size=BATCH)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  runner = runner_lib.ModelRunner(params, variables, options)
+  return engine_lib.ConsensusEngine(
+      runner, options, deliver=lambda t, ids, quals: None)
+
+
+def test_engine_compiles_once_per_shape(real_engine, params):
+  ids, quals = real_engine.predict_windows(_raw_windows(params, BATCH))
+  assert ids.shape == (BATCH, params.max_length)
+  with jtu.count_jit_and_pmap_lowerings() as count:
+    # Full packs AND ragged tails (flush pads them) must all reuse the
+    # executable paid for above.
+    for i, n in enumerate((BATCH, BATCH, BATCH // 2, 3, 1)):
+      ids, quals = real_engine.predict_windows(
+          _raw_windows(params, n, seed=i + 1))
+      assert ids.shape == (n, params.max_length)
+      assert quals.dtype == np.uint8
+  assert count[0] == 0, (
+      f'{count[0]} re-lowerings behind the engine boundary: the '
+      'forward is recompiled per submission instead of per shape')
+
+
+def test_engine_uint8_contract(real_engine, params):
+  ids, quals = real_engine.predict_windows(_raw_windows(params, 3, 7))
+  assert ids.dtype == np.uint8 and quals.dtype == np.uint8
+  assert quals.max() <= real_engine.options.max_base_quality
+
+
+# ----------------------------------------------------------------------
+# Packer edge cases at the engine boundary (port of test_window_packer)
+
+
+def test_full_packs_cut_across_submissions(params):
+  """3 submissions of 5 windows at batch_size=8: packs cut at 8-window
+  boundaries regardless of submission seams; tail pads on flush."""
+  engine, delivered, failures = _collecting_engine(params)
+  for s in range(3):
+    engine.submit(_raw_windows(params, 5, seed=s),
+                  [(s, i) for i in range(5)])
+  assert engine.n_packs == 1  # 15 buffered -> one full pack cut
+  engine.flush()
+  assert engine.n_packs == 2
+  assert engine.n_pack_rows == 15
+  assert engine.n_pad_rows == 2 * BATCH - 15
+  assert not failures
+  assert set(delivered) == {(s, i) for s in range(3) for i in range(5)}
+
+
+def test_delivery_matches_submission(params):
+  """Each ticket gets exactly its own window's result (stub echoes the
+  CCS row, so scatter correctness is observable)."""
+  engine, delivered, _ = _collecting_engine(params)
+  raw = _raw_windows(params, 11, seed=3)
+  engine.submit(raw, list(range(11)))
+  engine.flush()
+  mp = params.max_passes
+  for t in range(11):
+    np.testing.assert_array_equal(
+        delivered[t][0], raw[t, 4 * mp, :, 0].astype(np.uint8))
+    assert (delivered[t][1] == STUB_QUAL).all()
+
+
+def test_pack_failure_routes_tickets_not_deliver(params):
+  """A failed pack surfaces ALL of its tickets through on_pack_failure
+  and none through deliver; sibling packs are untouched."""
+  engine, delivered, failures = _collecting_engine(
+      params, fail_packs=(1,))
+  engine.submit(_raw_windows(params, 20, seed=4), list(range(20)))
+  engine.flush()
+  assert len(failures) == 1
+  failed_tickets, seq, err = failures[0]
+  assert seq == 1
+  assert failed_tickets == list(range(8, 16))
+  assert 'stub failure' in str(err)
+  assert set(delivered) == set(range(8)) | set(range(16, 20))
+
+
+def test_poison_ticket_fails_only_its_pack(params):
+  """poison_ticket makes exactly the pack carrying that ticket fail at
+  dispatch (the DCTPU_FAULT_POISON_WINDOW mechanism) and is
+  consume-once."""
+  engine, delivered, failures = _collecting_engine(params)
+  tickets = [object() for _ in range(20)]
+  engine.poison_ticket(tickets[10])  # lands in pack 1 (windows 8..15)
+  engine.submit(_raw_windows(params, 20, seed=5), tickets)
+  engine.flush()
+  assert len(failures) == 1
+  failed_tickets, seq, err = failures[0]
+  assert seq == 1
+  assert failed_tickets == tickets[8:16]
+  assert 'poison' in str(err)
+  assert set(map(id, delivered)) == set(
+      map(id, tickets[:8] + tickets[16:]))
+  # Consume-once: resubmitting the same ticket succeeds.
+  engine.submit(_raw_windows(params, 1, seed=6), [tickets[10]])
+  engine.flush()
+  assert len(failures) == 1
+  assert tickets[10] in delivered
+
+
+def test_submit_validates_ticket_alignment(params):
+  engine, _, _ = _collecting_engine(params)
+  with pytest.raises(ValueError, match='tickets'):
+    engine.submit(_raw_windows(params, 3), [1, 2])
+  with pytest.raises(ValueError, match='tickets'):
+    engine.submit_formatted(np.zeros((2, 4, 4, 1), np.float32), [1])
+
+
+def test_flush_without_drain_leaves_packs_in_flight(params):
+  engine, delivered, _ = _collecting_engine(params)
+  engine.submit(_raw_windows(params, 3, seed=8), [0, 1, 2])
+  engine.flush(drain=False)
+  assert engine.n_packs == 1
+  assert engine.has_work  # dispatched but not finalized
+  engine.flush(drain=True)
+  assert not engine.has_work
+  assert set(delivered) == {0, 1, 2}
+
+
+# ----------------------------------------------------------------------
+# Behavior preservation: engine-direct output == batch pipeline output
+
+
+def test_engine_reproduces_batch_pipeline_bytes(tmp_path, synthetic_bams,
+                                                params):
+  """Featurize a synthetic input once; polish it (a) through the full
+  run_inference pipeline and (b) by driving ConsensusEngine + stitch
+  directly. The FASTQ bytes must match exactly — the refactored
+  pipeline is a thin client of the same engine."""
+  subreads, ccs = synthetic_bams(subdir='bams_engine', n_zmws=6,
+                                 seq_len=600)
+
+  def make_options():
+    opts = runner_lib.InferenceOptions(
+        batch_size=BATCH, batch_zmws=100, skip_windows_above=0,
+        min_quality=0)
+    opts.max_passes = params.max_passes
+    opts.max_length = params.max_length
+    opts.use_ccs_bq = params.use_ccs_bq
+    return opts
+
+  # (a) the batch pipeline
+  options = make_options()
+  runner, _ = _stub_runner(params, BATCH)
+  out = str(tmp_path / 'pipeline.fastq')
+  runner_lib.run_inference(
+      subreads_to_ccs=subreads, ccs_bam=ccs, checkpoint=None,
+      output=out, options=options, runner=runner)
+  with open(out, 'rb') as f:
+    pipeline_bytes = f.read()
+
+  # (b) engine-direct: featurize, triage, submit, stitch, format
+  from deepconsensus_tpu.preprocess import (FeatureLayout,
+                                            create_proc_feeder)
+
+  options = make_options()
+  runner, _ = _stub_runner(params, BATCH)
+  layout = FeatureLayout(
+      max_passes=options.max_passes, max_length=options.max_length,
+      use_ccs_bq=options.use_ccs_bq)
+  feeder, _ = create_proc_feeder(
+      subreads_to_ccs=subreads, ccs_bam=ccs, layout=layout,
+      ins_trim=options.ins_trim)
+  mols = {}  # name -> [(pos, ids, quals)]
+
+  def deliver(ticket, ids, quals):
+    name, pos = ticket
+    mols[name].append((pos, ids, quals))
+
+  engine = engine_lib.ConsensusEngine(runner, options, deliver=deliver)
+  counter = collections.Counter()
+  for zmw_input in feeder():
+    features, _ = runner_lib.preprocess_zmw(zmw_input, options)
+    to_model, to_skip = engine_lib.triage_windows(
+        features, options, counter)
+    for fd in to_skip:
+      name = fd['name'] if isinstance(fd['name'], str) else fd['name'].decode()
+      mols.setdefault(name, []).append(
+          (fd['window_pos'],
+           *engine_lib.skipped_window_arrays(fd, options)))
+    tickets = []
+    for fd in to_model:
+      name = fd['name'] if isinstance(fd['name'], str) else fd['name'].decode()
+      mols.setdefault(name, [])
+      tickets.append((name, fd['window_pos']))
+    if to_model:
+      engine.submit(
+          np.stack([fd['subreads'] for fd in to_model]), tickets)
+  engine.flush()
+
+  outcome = stitch.OutcomeCounter()
+  direct = b''
+  for name in sorted(mols):
+    windows = mols[name]
+    result = stitch.stitch_arrays(
+        name,
+        np.asarray([w[0] for w in windows], dtype=np.int64),
+        np.stack([w[1] for w in windows]),
+        np.stack([w[2] for w in windows]),
+        max_length=options.max_length,
+        min_quality=options.min_quality,
+        min_length=options.min_length,
+        outcome_counter=outcome)
+    if result is not None:
+      direct += stitch.format_fastq_bytes(name, *result)
+  assert direct == pipeline_bytes
