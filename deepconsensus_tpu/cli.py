@@ -222,6 +222,30 @@ def _add_validate(sub):
                  '(always printed to stdout).')
 
 
+def _add_lint(sub):
+  p = sub.add_parser(
+      'lint',
+      help='AST static analysis over the package (tools/dclint): '
+      'typed-faults, jit-hazards, guarded-by, shape-literals.')
+  p.add_argument('lint_paths', nargs='*', metavar='PATH',
+                 help='Files/dirs to lint (default: the whole '
+                 'deepconsensus_tpu package).')
+  p.add_argument('--root', default=None, dest='lint_root',
+                 help='Repository root (default: autodetected).')
+  p.add_argument('--baseline', default=None, dest='lint_baseline',
+                 help='Baseline JSON path (default: '
+                 'tools/dclint/baseline.json).')
+  p.add_argument('--update-baseline', action='store_true',
+                 help='Rewrite the baseline with the current findings '
+                 '(refuses typed-faults/guarded-by entries: those get '
+                 'fixed, not suppressed).')
+  p.add_argument('--no-baseline', action='store_true',
+                 help='Ignore the baseline; report and fail on every '
+                 'finding.')
+  p.add_argument('--format', choices=('text', 'json'), default='text',
+                 dest='lint_format')
+
+
 def _add_train(sub):
   p = sub.add_parser('train', help='Train a model.')
   p.add_argument('--config', default='transformer_learn_values+test',
@@ -363,6 +387,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_run(sub)
   _add_serve(sub)
   _add_validate(sub)
+  _add_lint(sub)
   _add_train(sub)
   _add_distill(sub)
   _add_export(sub)
@@ -434,6 +459,36 @@ def _dispatch(args) -> int:
       with open(args.report, 'w') as f:
         f.write(text + '\n')
     return 0 if report['ok'] else 1
+
+  if args.command == 'lint':
+    import os
+
+    try:
+      from tools.dclint import __main__ as dclint_main
+    except ImportError:
+      # Installed-package invocation: tools/ is not shipped, but a
+      # source checkout keeps it two levels above this file.
+      import deepconsensus_tpu
+
+      repo_root = os.path.dirname(os.path.dirname(
+          os.path.abspath(deepconsensus_tpu.__file__)))
+      if not os.path.isdir(os.path.join(repo_root, 'tools', 'dclint')):
+        raise ValueError(
+            'dctpu lint needs a source checkout (tools/dclint not '
+            f'found under {repo_root})')
+      sys.path.insert(0, repo_root)
+      from tools.dclint import __main__ as dclint_main
+    lint_argv = list(args.lint_paths)
+    if args.lint_root:
+      lint_argv += ['--root', args.lint_root]
+    if args.lint_baseline:
+      lint_argv += ['--baseline', args.lint_baseline]
+    if args.update_baseline:
+      lint_argv.append('--update-baseline')
+    if args.no_baseline:
+      lint_argv.append('--no-baseline')
+    lint_argv += ['--format', args.lint_format]
+    return dclint_main.run(lint_argv)
 
   if args.command == 'serve':
     import json

@@ -178,6 +178,9 @@ class _WindowPacker:
         if hit:
           for t in hit:
             self._poisoned.discard(id(t))
+          # dclint: allow=typed-faults (fault-injection hook: must be
+          # a bare RuntimeError so it trips the pack-failure path the
+          # same way a real dispatch error would)
           raise RuntimeError(
               'injected poison window payload '
               f'({faults_lib.ENV_POISON_WINDOW}; {len(hit)} window(s) '
@@ -277,6 +280,8 @@ class ConsensusEngine:
     from deepconsensus_tpu.models import data as data_lib
 
     if len(raw_windows) != len(tickets):
+      # dclint: allow=typed-faults (caller API misuse guard, not a
+      # data-plane fault: both args come from the same client code)
       raise ValueError(
           f'{len(raw_windows)} windows vs {len(tickets)} tickets')
     if not len(raw_windows):
@@ -290,6 +295,8 @@ class ConsensusEngine:
     """submit() for rows already through data.format_rows_batch (the
     serve retry path re-dispatches without re-formatting)."""
     if len(rows) != len(tickets):
+      # dclint: allow=typed-faults (caller API misuse guard, not a
+      # data-plane fault: both args come from the same client code)
       raise ValueError(f'{len(rows)} rows vs {len(tickets)} tickets')
     if len(rows):
       self._packer.add(np.asarray(rows), list(tickets))

@@ -36,16 +36,40 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+# Full public surface of the shared module, so callers never have to
+# know which side of the split a name lives on. tests/test_dclint.py
+# asserts this block stays in sync (no drift: every shared public name
+# resolves here to the identical object).
 from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ENV_CRASH_AFTER_BATCHES,
+    ENV_KILL_SHARD_READER,
     ENV_KILL_TOKEN,
+    ENV_KILL_TRAIN_AT_STEP,
     ENV_KILL_ZMW,
+    ENV_NAN_AT_STEP,
+    ENV_POISON_WINDOW,
+    ENV_SERVE_CLIENT_FAULT,
+    ENV_SERVE_CLIENT_FAULT_ZMW,
+    ENV_SIGTERM_AT_STEP,
     _TRANSIENT_MARKERS,
+    BackpressureError,
+    BadRequestError,
+    CorruptInputError,
+    CrashLoopError,
     DeadLetterWriter,
+    DeadlineExceededError,
+    DrainingError,
     FaultKind,
+    NonFiniteTrainingError,
+    RequestTooLargeError,
+    ServeRejection,
     classify_error,
     injected_crash_after_batches,
+    maybe_kill_shard_reader,
+    maybe_kill_train_at_step,
     maybe_kill_worker,
+    maybe_poison_batch,
+    maybe_sigterm_at_step,
     read_dead_letters,
 )
 
@@ -167,6 +191,8 @@ class Quarantine:
 
   def __init__(self, policy: str, dead_letter: Optional[DeadLetterWriter]):
     if policy not in OnZmwError.CHOICES:
+      # dclint: allow=typed-faults (flag validation at startup: the
+      # CLI maps ValueError to operator-error exit code 2)
       raise ValueError(
           f'on_zmw_error must be one of {OnZmwError.CHOICES}, '
           f'got {policy!r}'
@@ -203,6 +229,8 @@ class Quarantine:
     if self.policy == OnZmwError.CCS_FALLBACK and fallback is not None:
       try:
         payload = fallback()
+      # dclint: allow=typed-faults (the fallback failing degrades the
+      # action to skip; the quarantine record below still routes it)
       except Exception as fb_err:  # fallback itself unrecoverable
         log.warning('ccs-fallback for %s failed (%s); skipping', zmw, fb_err)
       if payload is not None:
@@ -322,6 +350,8 @@ class PoolWatchdog:
     try:
       self.pool.terminate()
       self.pool.join()
+    # dclint: allow=typed-faults (teardown is best-effort: the pool is
+    # being replaced; shm reclamation below still runs)
     except Exception as e:  # pragma: no cover - teardown best-effort
       log.warning('pool terminate failed: %s', e)
     reclaim_shm_segments(shm_prefix)
@@ -332,6 +362,8 @@ class PoolWatchdog:
     try:
       self.pool.close()
       self.pool.join()
+    # dclint: allow=typed-faults (teardown is best-effort: escalate a
+    # failed close to terminate, nothing to route)
     except Exception:  # pragma: no cover - teardown best-effort
       self.pool.terminate()
       self.pool.join()
@@ -403,6 +435,8 @@ def validate_resume_source(state: Dict[str, Any],
   recorded = state.get('source') or {}
   for key, value in source.items():
     if recorded.get(key) != value:
+      # dclint: allow=typed-faults (operator error at startup; tests
+      # and the CLI rely on ValueError('manifest mismatch ...'))
       raise ValueError(
           f'--resume manifest mismatch for {key!r}: run was started '
           f'with {recorded.get(key)!r}, resume requested {value!r} '

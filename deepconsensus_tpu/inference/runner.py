@@ -161,6 +161,8 @@ def _bq_row_index(params) -> Optional[int]:
   from deepconsensus_tpu.preprocess import pileup
 
   if params.PW_MAX > 255 or params.IP_MAX > 255:
+    # dclint: allow=typed-faults (model-config validation at startup,
+    # surfaced as operator error by the CLI, not a data-plane fault)
     raise ValueError(
         f'compact uint8 dispatch requires PW_MAX/IP_MAX <= 255, got '
         f'{params.PW_MAX}/{params.IP_MAX}'
@@ -178,6 +180,8 @@ def _check_dp_divisible(options: 'InferenceOptions', mesh) -> int:
 
   dp = mesh.shape[mesh_lib.DATA_AXIS]
   if options.batch_size % dp:
+    # dclint: allow=typed-faults (startup config validation: operator
+    # picked a batch size the mesh cannot split)
     raise ValueError(
         f'batch_size={options.batch_size} not divisible by the mesh '
         f'data axis ({dp} devices)'
@@ -291,6 +295,8 @@ class ModelRunner:
     if not meta.get('polymorphic_batch'):
       # Fixed-batch artifact: the compiled shape wins over the flag.
       if mesh is not None:
+        # dclint: allow=typed-faults (startup artifact/flag mismatch,
+        # an operator error — not a runtime data-plane fault)
         raise ValueError(
             'mesh/--dp serving of an exported artifact requires a '
             'batch-polymorphic export (this artifact is fixed-batch; '
@@ -324,6 +330,8 @@ class ModelRunner:
 
     if mesh_lib.MODEL_AXIS in mesh.shape and (
         mesh.shape[mesh_lib.MODEL_AXIS] > 1):
+      # dclint: allow=typed-faults (startup artifact/flag mismatch,
+      # an operator error — not a runtime data-plane fault)
       raise ValueError(
           'exported artifacts serve data-parallel only (the compiled '
           'program cannot be re-sharded on the model axis); use tp=1 '
@@ -376,7 +384,10 @@ class ModelRunner:
     pred_ids, max_prob, n = dispatched
     # Slice on the host: indexing the device array with a varying [:n]
     # would lower (and cache) a fresh jitted slice per tail size.
+    # dclint: allow=jit-hazards (finalize IS the sync point: results
+    # must land on the host here, after the async dispatch window)
     pred_ids = np.asarray(pred_ids)[:n]
+    # dclint: allow=jit-hazards (same deliberate sync as pred_ids)
     max_prob = np.asarray(max_prob)[:n]
     error_prob = np.maximum(1.0 - max_prob, 1e-12)
     quality = -10.0 * np.log10(error_prob)
@@ -480,6 +491,8 @@ def preprocess_zmw_shm(zmw_input, options: InferenceOptions,
   # worker exits; ownership transfers to the parent instead.
   try:
     resource_tracker.unregister(f'/{name}', 'shared_memory')
+  # dclint: allow=typed-faults (best-effort unregister: on failure the
+  # tracker merely logs a spurious leak warning at exit)
   except Exception:  # pragma: no cover - tracker internals shifted
     pass
   return name, meta, counter
@@ -495,6 +508,9 @@ def _pool_worker(zmw_input, options: InferenceOptions,
     if isinstance(name, str):
       faults.maybe_kill_worker(name)
     return 'ok', preprocess_zmw_shm(zmw_input, options, shm_prefix)
+  # dclint: allow=typed-faults (routes the error to the parent as an
+  # ('error', traceback) result; raising would make starmap discard
+  # the whole batch and orphan sibling shm segments)
   except BaseException:
     import traceback
 
@@ -726,6 +742,8 @@ def run_inference(
   options = options or InferenceOptions()
   if runner is None:
     if checkpoint is None:
+      # dclint: allow=typed-faults (API misuse by the caller, not a
+      # data-plane fault; the CLI maps it to exit code 2)
       raise ValueError('need checkpoint or runner')
     runner = ModelRunner.from_checkpoint(checkpoint, options, mesh=mesh)
   params = runner.params
@@ -784,6 +802,8 @@ def run_inference(
       max_length=options.max_length,
       use_ccs_bq=options.use_ccs_bq,
   )
+  # dclint: lock-free (producer thread owns the feeder's counter while
+  # it runs; the main thread merges into it only after the join)
   feeder, counter = create_proc_feeder(
       subreads_to_ccs=subreads_to_ccs,
       ccs_bam=ccs_bam,
@@ -815,8 +835,15 @@ def run_inference(
   # prefix without touching other in-flight batches' segments.
   shm_run_prefix = f'dctpu_{os.getpid()}_'
   outcome = stitch.OutcomeCounter()
+  # dclint: lock-free (emit worker owns it while running; the main
+  # thread writes only the disjoint n_model_pack* keys, merges after
+  # the join — see the counter-discipline note in the main loop)
   window_counter: collections.Counter = collections.Counter()
+  # dclint: lock-free (list.append is atomic under the GIL; rows are
+  # only aggregated after both worker threads have joined)
   timing_rows: List[Dict[str, Any]] = []
+  # dclint: lock-free (single writer: the emit worker via nonlocal;
+  # the main thread reads it after the emit queue drains)
   fastq_lines = 0
 
   if output.endswith('.bam'):
@@ -932,7 +959,11 @@ def run_inference(
             for zmw_input, (status, payload) in zip(zmws, raw):
               if status != 'ok':
                 if quarantine is None:
-                  raise RuntimeError(
+                  zmw_name = (zmw_input[1]
+                              if len(zmw_input) > 1 else None)
+                  raise faults.ZmwFault(
+                      zmw_name if isinstance(zmw_name, str) else None,
+                      'featurize', faults.classify_error(payload),
                       f'featurization worker failed:\n{payload}'
                   )
                 quarantine_featurize(
@@ -1179,6 +1210,8 @@ def run_inference(
 
       emit_queue: Optional['queue_lib.Queue'] = None
       emit_thread: Optional[threading.Thread] = None
+      # dclint: lock-free (single-writer cell: only the emit worker
+      # stores into it; the main thread polls it via check_emit)
       emit_error: List[Optional[BaseException]] = [None]
       emit_stop = threading.Event()
 
@@ -1251,10 +1284,14 @@ def run_inference(
             emit_batch_state(state)
             emitted += 1
             if crash_after and emitted >= crash_after:
+              # dclint: allow=typed-faults (fault-injection hook: the
+              # resilience tests expect a bare RuntimeError crash)
               raise RuntimeError(
                   f'injected crash after {emitted} batch(es) '
                   f'({faults.ENV_CRASH_AFTER_BATCHES})'
               )
+        # dclint: allow=typed-faults (routes the error to the main
+        # thread through the emit_error cell; check_emit() re-raises)
         except BaseException as e:  # surfaced via check_emit()
           emit_error[0] = e
 
@@ -1308,6 +1345,8 @@ def run_inference(
             # consumer; with one, the injection moves there so the
             # crash still lands just after a manifest commit (see
             # emit_worker).
+            # dclint: allow=typed-faults (fault-injection hook: the
+            # resilience tests expect a bare RuntimeError crash)
             raise RuntimeError(
                 f'injected crash after {batches_ingested} batch(es) '
                 f'({faults.ENV_CRASH_AFTER_BATCHES})'
@@ -1316,6 +1355,8 @@ def run_inference(
           engine.flush()  # end of input: cut the tail pack, drain all
         pop_ready()
         if states:
+          # dclint: allow=typed-faults (internal invariant violation —
+          # a packer accounting bug, not an input or request fault)
           raise RuntimeError(
               f'{len(states)} featurize batch(es) never completed the '
               'model stage (packer accounting bug)')
@@ -1381,6 +1422,8 @@ def run_inference(
         csv_writer.writerows(timing_rows)
       with open(output + '.inference.json', 'w') as f:
         json.dump(counters, f, indent=2, sort_keys=True)
+    # dclint: allow=typed-faults (sidecar stats are best-effort: a
+    # failed write is logged, never masks the run's own outcome)
     except Exception:  # never mask the run's own error with sidecar IO
       log.exception('failed to write sidecar outputs for %s', output)
   if not outcome.success and options.end_after_stage == 'full':

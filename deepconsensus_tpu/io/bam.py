@@ -421,6 +421,8 @@ class BamReader:
             import io
 
             self._f = io.BytesIO(data)
+      # dclint: allow=typed-faults (native decompress is an optional
+      # accelerator: any failure falls back to the gzip path below)
       except Exception:  # pragma: no cover - fallback path
         self._f = None
     if self._f is None:

@@ -98,6 +98,8 @@ def _encode_tag(name: str, value: Any) -> bytes:
       out += b'B' + b'i' + struct.pack('<I', arr.size)
       out += arr.astype('<i4').tobytes()
   else:
+    # dclint: allow=typed-faults (output plane: the tag values are
+    # produced by our own emit code, so this is a programmer error)
     raise ValueError(f'unsupported tag type for {name}: {type(value)}')
   return bytes(out)
 

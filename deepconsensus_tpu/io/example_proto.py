@@ -13,6 +13,8 @@ from __future__ import annotations
 import struct
 from typing import Dict, Iterator, List, Tuple, Union
 
+from deepconsensus_tpu.faults import CorruptInputError
+
 FeatureValue = Union[List[bytes], List[float], List[int]]
 
 _BYTES_KIND = 1
@@ -119,6 +121,8 @@ class Example:
       inner += packed
       field_num = _INT64_KIND
     else:
+      # dclint: allow=typed-faults (serialisation path: the kind comes
+      # from our own feature tables, so this is a programmer error)
       raise ValueError(f'unknown feature kind {kind!r}')
     out = bytearray()
     out.append((field_num << 3) | 2)
@@ -171,7 +175,9 @@ class Example:
         yield field_num, wire_type, buf[pos : pos + 8]
         pos += 8
       else:
-        raise ValueError(f'unsupported wire type {wire_type}')
+        raise CorruptInputError(
+            f'unsupported wire type {wire_type}', offset=pos,
+            recoverable=False)
 
   @classmethod
   def _parse_feature(cls, buf: bytes) -> Tuple[str, FeatureValue]:

@@ -60,6 +60,8 @@ def crc32c(data: bytes, value: int = 0) -> int:
     result = native.crc32c(data, value)
     if result is not None:
       return result
+  # dclint: allow=typed-faults (native crc32c is an optional
+  # accelerator: any failure falls back to the pure-Python CRC)
   except Exception:  # pragma: no cover
     pass
   return _crc32c_py(data, value)
@@ -221,6 +223,9 @@ class TFRecordReader:
           self._path, n_threads=self._native_threads,
           compressed=self._compressed,
           max_out=_NATIVE_MAX_DECOMPRESSED_BYTES)
+    # dclint: allow=typed-faults (native reader is an optional
+    # accelerator: returning None routes to the Python decode path,
+    # which re-raises real corruption as CorruptInputError)
     except Exception:  # pragma: no cover - any native issue -> fallback
       return None
 

@@ -202,6 +202,9 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
                              isinstance(e, faults_lib.CorruptInputError)))
         )
     if not produced and on_shard_error == OnShardError.SKIP:
+      # dclint: allow=typed-faults (aggregate stop after every
+      # per-shard fault was already routed to the counters; tests pin
+      # RuntimeError('every shard failed ...'))
       raise RuntimeError(
           f'every shard failed to decode under on_shard_error=skip: '
           f'{paths}'
@@ -275,6 +278,8 @@ class DatasetIterator:
         break
       minimal.append(parse_example_minimal(raw, self.inference, with_name))
     if not minimal:
+      # dclint: allow=typed-faults (startup config error: the operator
+      # pointed the loader at an empty glob)
       raise ValueError(f'no examples matched {self.patterns!r}')
     batch = _batch_from_minimal(minimal, self.params, self.inference)
     minimal.clear()
@@ -344,12 +349,15 @@ class StreamingDataset:
     from deepconsensus_tpu.io.tfrecord import glob_paths
 
     if self.on_shard_error not in OnShardError.CHOICES:
+      # dclint: allow=typed-faults (flag validation at startup)
       raise ValueError(
           f'on_shard_error must be one of {OnShardError.CHOICES}, '
           f'got {self.on_shard_error!r}'
       )
     self._paths = glob_paths(self.patterns)
     if not self._paths:
+      # dclint: allow=typed-faults (startup config error: the operator
+      # pointed the loader at an empty glob)
       raise ValueError(f'no shards matched {self.patterns!r}')
     self._rng = np.random.default_rng(self.seed)
     self._with_name = bool(self.params.get('track_window_ids', False))
@@ -386,6 +394,9 @@ class StreamingDataset:
       if not produced:
         # All shards bad: without this the skip policy would spin
         # forever yielding nothing while the consumer waits.
+        # dclint: allow=typed-faults (aggregate stop after every
+        # per-shard fault was already routed to the counters; tests
+        # pin RuntimeError('every shard failed ...'))
         raise RuntimeError(
             f'every shard failed to decode under on_shard_error=skip: '
             f'{self._paths}'
@@ -464,6 +475,9 @@ class StreamingDataset:
             f'{worker_paths[w]}'
             for w, code in crashed
         )
+        # dclint: allow=typed-faults (worker-process death is an infra
+        # failure, not an input fault; tests pin the RuntimeError
+        # message naming the dead worker's owned shards)
         raise RuntimeError(
             f'StreamingDataset worker(s) crashed ({len(crashed)} of '
             f'{n_workers}): {detail}; check shard paths/integrity '
@@ -471,6 +485,8 @@ class StreamingDataset:
         )
       if not any(p.is_alive() for p in procs):
         codes = [p.exitcode for p in procs]
+        # dclint: allow=typed-faults (worker-process death is an infra
+        # failure, not an input fault)
         raise RuntimeError(
             f'all {n_workers} StreamingDataset workers exited '
             f'(exit codes {codes}); check shard paths/integrity'

@@ -230,6 +230,8 @@ def maybe_sabotage(host: str, port: int, name: str,
   if scope and scope not in name:
     return None
   if mode not in CLIENT_FAULT_MODES:
+    # dclint: allow=typed-faults (fault-injection env validation: a
+    # typo in the harness knob should abort the test loudly)
     raise ValueError(
         f'{shared_faults.ENV_SERVE_CLIENT_FAULT}={mode!r}: must be one '
         f'of {CLIENT_FAULT_MODES}')

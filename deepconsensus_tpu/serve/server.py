@@ -67,6 +67,9 @@ class _DeadlineSocketIO(io.RawIOBase):
   def readinto(self, b) -> int:
     remaining = self.deadline - time.monotonic()
     if remaining <= 0:
+      # dclint: allow=typed-faults (socket.timeout is what
+      # http.server's rfile machinery expects from a slow read; a
+      # faults.py type would bypass its 408 handling)
       raise socket.timeout(
           f'request not fully read within io_timeout_s='
           f'{self._io_timeout_s}')

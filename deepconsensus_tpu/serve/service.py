@@ -71,6 +71,8 @@ class ServeOptions:
   def __post_init__(self):
     if self.on_request_error not in (faults.OnZmwError.SKIP,
                                      faults.OnZmwError.CCS_FALLBACK):
+      # dclint: allow=typed-faults (startup flag validation: cli main
+      # maps ValueError to exit code 2 before the service exists)
       raise ValueError(
           "on_request_error must be 'skip' or 'ccs-fallback', got "
           f'{self.on_request_error!r}')
@@ -142,15 +144,22 @@ class ConsensusService:
     self._queue: 'queue_lib.Queue[_RequestState]' = queue_lib.Queue(
         maxsize=max(1, serve_options.admit_queue_depth))
     self._lock = threading.Lock()
-    self._outstanding: set = set()
+    self._outstanding: set = set()  # guarded by: self._lock
+    # dclint: lock-free (monotonic False->True flag; a stale read only
+    # delays drain one loop tick, and the loop re-checks under lock)
     self._draining = False
     self._stopped = threading.Event()
+    # dclint: lock-free (written once by warmup before traffic starts)
     self._warm = False
+    # dclint: lock-free (single writer: the model loop; handlers read
+    # at-worst-stale None and fail the next health check instead)
     self._loop_error: Optional[BaseException] = None
-    self._next_id = 0
+    self._next_id = 0  # guarded by: self._lock
     self._retries: List[Tuple[_RequestState, List[_Ticket], int, str]] = []
     self._latencies: 'collections.deque[float]' = collections.deque(
-        maxlen=8192)
+        maxlen=8192)  # guarded by: self._lock
+    # dclint: lock-free (mutated only by the model loop via stitch;
+    # stats() reads int fields whose torn values are tolerable)
     self.outcome = stitch.OutcomeCounter()
     dead_letter = None
     if serve_options.dead_letter_path:
@@ -491,7 +500,8 @@ class ConsensusService:
           'counters': dict(state.counters),
           'error': state.error or '',
       }
-    self._latencies.append(time.monotonic() - state.t_submit)
+    with self._lock:
+      self._latencies.append(time.monotonic() - state.t_submit)
     state.event.set()
 
   def _release(self, state: _RequestState) -> None:
@@ -513,7 +523,11 @@ class ConsensusService:
   # Observability
 
   def latency_percentiles(self) -> Dict[str, Optional[float]]:
-    lat = sorted(self._latencies)
+    # Snapshot under the lock: sorted() iterates the deque, and a
+    # concurrent model-loop append raises "deque mutated during
+    # iteration" under /metricz traffic.
+    with self._lock:
+      lat = sorted(self._latencies)
     if not lat:
       return {'p50_s': None, 'p99_s': None, 'n': 0}
     return {

@@ -6,6 +6,8 @@
 #   ./run_all_tests.sh resilience  # fault-injection suite only
 #   ./run_all_tests.sh io-fuzz     # corruption-fuzz harness only (deep
 #                                  # sweep, 2000 mutants per format)
+#   ./run_all_tests.sh lint        # dclint static analysis only
+#                                  # (also runs first in default/fast)
 #   ./run_all_tests.sh serve       # `dctpu serve` stage only (engine
 #                                  # boundary, service fault drills,
 #                                  # SIGTERM-under-load drain)
@@ -21,7 +23,12 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+if [[ "${1:-}" == "lint" ]]; then
+  exec python -m tools.dclint
+fi
+
 if [[ "${1:-}" == "fast" ]]; then
+  python -m tools.dclint
   exec python -m pytest tests/ -q -m 'not slow'
 fi
 
@@ -42,6 +49,10 @@ if [[ "${1:-}" == "serve" ]]; then
   exec scripts/run_resilience.sh --serve
 fi
 
+# Static analysis first: dclint runs in under a second and fails fast
+# on new typed-faults / jit-hazards / guarded-by / shape-literals
+# violations (docs/development.md).
+python -m tools.dclint
 python -m pytest tests/ -q
 # The resilience marker includes slow fault-injection tests (subprocess
 # SIGKILL/resume) that the main invocation deselects.

@@ -1,0 +1,578 @@
+"""Self-tests for the dclint static-analysis suite (tools/dclint).
+
+Each rule gets fixture snippets: seeded violations the checker must
+catch and clean snippets it must pass. Checkers take a virtual
+repo-relative path, so fixtures never touch the real tree; the
+baseline / CLI tests use a tmp mirror tree instead. The repo-wide
+tests are the actual gate: `dctpu lint` must exit 0 against the
+committed baseline, and the typed-faults / guarded-by baselines must
+stay empty (violations get fixed, not suppressed).
+"""
+import json
+import pathlib
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+  sys.path.insert(0, str(REPO_ROOT))
+
+from tools.dclint import __main__ as dclint_main
+from tools.dclint import config as dclint_config
+from tools.dclint import core
+from tools.dclint import guarded_by
+from tools.dclint import jit_hazards
+from tools.dclint import shape_literals
+from tools.dclint import typed_faults
+
+
+def findings_for(checker, path, source):
+  src = core.SourceFile(path, textwrap.dedent(source))
+  return checker.check(src)
+
+
+def lines_of(findings):
+  return sorted(f.line for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# typed-faults
+# ---------------------------------------------------------------------------
+
+
+class TestTypedFaults:
+
+  IO_PATH = 'deepconsensus_tpu/io/fixture.py'
+
+  def test_catches_bare_valueerror(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        def parse(buf):
+          if not buf:
+            raise ValueError('empty buffer')
+        """)
+    assert len(found) == 1 and found[0].rule == 'typed-faults'
+
+  def test_catches_bare_runtimeerror_in_serve(self):
+    found = findings_for(
+        typed_faults, 'deepconsensus_tpu/serve/fixture.py', """\
+        def admit(req):
+          raise RuntimeError('queue full')
+        """)
+    assert len(found) == 1
+
+  def test_catches_swallowing_broad_except(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        def read(path):
+          try:
+            return open(path).read()
+          except Exception:
+            return None
+        """)
+    assert len(found) == 1
+    assert 'broad' in found[0].message
+
+  def test_catches_bare_except(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        def read(path):
+          try:
+            return decode(path)
+          except:
+            pass
+        """)
+    assert len(found) == 1
+
+  def test_passes_typed_fault_raise(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        from deepconsensus_tpu.faults import CorruptInputError
+
+        def parse(buf, path):
+          if not buf:
+            raise CorruptInputError('empty buffer', path=path)
+        """)
+    assert found == []
+
+  def test_passes_reraise_and_routing_handler(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        def run(quarantine):
+          try:
+            step()
+          except Exception as e:
+            quarantine.record_failure('zmw', e)
+          try:
+            step()
+          except Exception:
+            raise
+        """)
+    assert found == []
+
+  def test_passes_local_subclass_of_fault(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        from deepconsensus_tpu.faults import CorruptInputError
+
+        class TruncatedError(CorruptInputError):
+          pass
+
+        def parse(buf):
+          raise TruncatedError('short read')
+        """)
+    assert found == []
+
+  def test_allow_comment_suppresses(self):
+    found = findings_for(typed_faults, self.IO_PATH, """\
+        def parse(kind):
+          # dclint: allow=typed-faults (programmer error, not a fault)
+          raise ValueError(f'unknown kind {kind}')
+        """)
+    assert found == []
+
+  def test_out_of_scope_file_ignored(self):
+    found = findings_for(
+        typed_faults, 'deepconsensus_tpu/models/model.py', """\
+        def f():
+          raise ValueError('not data plane')
+        """)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# jit-hazards
+# ---------------------------------------------------------------------------
+
+
+class TestJitHazards:
+
+  ENGINE = 'deepconsensus_tpu/inference/engine.py'
+  RUNNER = 'deepconsensus_tpu/inference/runner.py'
+  SERVICE = 'deepconsensus_tpu/serve/service.py'
+
+  def test_catches_jit_in_loop(self):
+    found = findings_for(jit_hazards, self.ENGINE, """\
+        import jax
+
+        def run(batches, f):
+          for b in batches:
+            fwd = jax.jit(f)
+            fwd(b)
+        """)
+    assert any('inside a loop' in f.message for f in found)
+
+  def test_catches_jit_in_hot_function(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+
+        class R:
+          def dispatch(self, rows):
+            fwd = jax.jit(self._forward)
+            return fwd(rows)
+        """)
+    assert any('hot function' in f.message for f in found)
+
+  def test_catches_scalar_arg_at_jitted_call_site(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+
+        fwd = jax.jit(lambda x, n: x)
+
+        def predict(rows):
+          return fwd(rows, len(rows))
+        """)
+    assert any('Python-scalar' in f.message for f in found)
+
+  def test_catches_item_in_hot_function(self):
+    found = findings_for(jit_hazards, self.SERVICE, """\
+        class S:
+          def _model_loop(self):
+            out = self._runner.dispatch(self._batch)
+            return out.sum().item()
+        """)
+    assert any('.item()' in f.message for f in found)
+
+  def test_catches_asarray_of_device_value(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import numpy as np
+
+        class R:
+          def predict(self, rows):
+            out = self.dispatch(rows)
+            return np.asarray(out)
+        """)
+    assert any('materialises a device value' in f.message
+               for f in found)
+
+  def test_passes_init_scope_jit_and_array_args(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+
+        class R:
+          def __init__(self, f):
+            self._fwd = jax.jit(f)
+
+          def predict(self, rows):
+            return self._fwd(rows)
+        """)
+    assert found == []
+
+  def test_passes_allowed_deliberate_sync(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import numpy as np
+
+        class R:
+          def finalize(self, dispatched):
+            # dclint: allow=jit-hazards (this IS the sync point)
+            return np.asarray(dispatched)
+        """)
+    assert found == []
+
+  def test_passes_asarray_of_host_value(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import numpy as np
+
+        class R:
+          def predict(self, rows):
+            host = list(range(4))
+            return np.asarray(host)
+        """)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+
+class TestGuardedBy:
+
+  SERVICE = 'deepconsensus_tpu/serve/service.py'
+
+  def test_catches_unannotated_shared_attribute(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        import threading
+
+        class S:
+          def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self._t = threading.Thread(target=self._loop)
+
+          def _loop(self):
+            self.count += 1
+
+          def stats(self):
+            return self.count
+        """)
+    assert any('self.count' in f.message for f in found)
+
+  def test_catches_guarded_access_outside_lock(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        import threading
+
+        class S:
+          def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0  # guarded by: self._lock
+            self._t = threading.Thread(target=self._loop)
+
+          def _loop(self):
+            with self._lock:
+              self.count += 1
+
+          def stats(self):
+            return self.count
+        """)
+    assert any('outside `with self._lock:`' in f.message
+               for f in found)
+
+  def test_catches_unannotated_shared_closure_var(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        import threading
+
+        def run(batches):
+          done = []
+
+          def worker():
+            done.append(1)
+
+          t = threading.Thread(target=worker)
+          t.start()
+          done.append(0)
+          t.join()
+          return done
+        """)
+    assert any('closure variable `done`' in f.message for f in found)
+
+  def test_passes_locked_attribute(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        import threading
+
+        class S:
+          def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0  # guarded by: self._lock
+            self._t = threading.Thread(target=self._loop)
+
+          def _loop(self):
+            with self._lock:
+              self.count += 1
+
+          def stats(self):
+            with self._lock:
+              return self.count
+        """)
+    assert found == []
+
+  def test_passes_lock_free_annotation(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        import threading
+
+        class S:
+          def __init__(self):
+            # dclint: lock-free (monotonic flag, single writer)
+            self._draining = False
+            self._t = threading.Thread(target=self._loop)
+
+          def _loop(self):
+            while not self._draining:
+              pass
+
+          def drain(self):
+            self._draining = True
+        """)
+    assert found == []
+
+  def test_passes_queue_attribute_and_safe_publish(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        import queue
+        import threading
+
+        def run(batches):
+          work = queue.Queue()
+          sink = open('/dev/null', 'w')
+
+          def worker():
+            while True:
+              sink.write(work.get())
+
+          t = threading.Thread(target=worker)
+          t.start()
+          for b in batches:
+            work.put(b)
+          t.join()
+        """)
+    assert found == []
+
+  def test_single_threaded_class_ignored(self):
+    found = findings_for(guarded_by, self.SERVICE, """\
+        class S:
+          def __init__(self):
+            self.count = 0
+
+          def bump(self):
+            self.count += 1
+        """)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# shape-literals
+# ---------------------------------------------------------------------------
+
+
+class TestShapeLiterals:
+
+  PATH = 'deepconsensus_tpu/inference/fixture.py'
+
+  def test_catches_shape_assignment(self):
+    found = findings_for(shape_literals, self.PATH, """\
+        max_length = 100
+        """)
+    assert len(found) == 1 and '100' in found[0].message
+
+  def test_catches_shape_keyword(self):
+    found = findings_for(shape_literals, self.PATH, """\
+        def f(make):
+          return make(example_width=100)
+        """)
+    assert len(found) == 1
+
+  def test_catches_shape_comparison(self):
+    found = findings_for(shape_literals, self.PATH, """\
+        def fits(rows):
+          return rows.shape[-1] <= 128
+        """)
+    assert len(found) == 1
+
+  def test_catches_shape_param_default(self):
+    found = findings_for(shape_literals, self.PATH, """\
+        def windows(reads, window_len=100):
+          return reads[:window_len]
+        """)
+    assert len(found) == 1
+
+  def test_passes_non_shape_literal(self):
+    found = findings_for(shape_literals, self.PATH, """\
+        RETRIES = 100
+
+        def f(xs):
+          return xs[:100] + list(range(128))
+        """)
+    assert found == []
+
+  def test_passes_config_py(self):
+    found = findings_for(
+        shape_literals, 'deepconsensus_tpu/models/config.py', """\
+        max_length = 100
+        """)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline workflow (tmp mirror tree)
+# ---------------------------------------------------------------------------
+
+
+def make_tree(tmp_path, rel_path, source):
+  p = tmp_path / rel_path
+  p.parent.mkdir(parents=True, exist_ok=True)
+  p.write_text(textwrap.dedent(source))
+  return p
+
+
+class TestBaselineWorkflow:
+
+  SHAPE_VIOLATION = """\
+      max_length = 100
+      """
+
+  def test_new_violation_fails(self, tmp_path, capsys):
+    make_tree(tmp_path, 'deepconsensus_tpu/inference/x.py',
+              self.SHAPE_VIOLATION)
+    assert dclint_main.run(['--root', str(tmp_path)]) == 1
+
+  def test_update_then_clean_then_new_violation(self, tmp_path):
+    f = make_tree(tmp_path, 'deepconsensus_tpu/inference/x.py',
+                  self.SHAPE_VIOLATION)
+    root = ['--root', str(tmp_path)]
+    assert dclint_main.run(root + ['--update-baseline']) == 0
+    baseline = tmp_path / 'tools' / 'dclint' / 'baseline.json'
+    assert baseline.exists()
+    # Baselined finding no longer fails.
+    assert dclint_main.run(root) == 0
+    # A NEW violation (different line text) still fails.
+    f.write_text(f.read_text() + 'example_width = 100\n')
+    assert dclint_main.run(root) == 1
+    # --no-baseline reports everything.
+    assert dclint_main.run(root + ['--no-baseline']) == 1
+
+  def test_update_baseline_refuses_zero_baseline_rules(
+      self, tmp_path, capsys):
+    make_tree(tmp_path, 'deepconsensus_tpu/io/x.py', """\
+        def f():
+          raise ValueError('nope')
+        """)
+    assert dclint_main.run(['--root', str(tmp_path),
+                            '--update-baseline']) == 1
+    out = capsys.readouterr().out
+    assert 'refusing to baseline' in out
+    assert not (tmp_path / 'tools' / 'dclint' / 'baseline.json').exists()
+
+  def test_fingerprints_survive_line_moves(self, tmp_path):
+    f = make_tree(tmp_path, 'deepconsensus_tpu/inference/x.py',
+                  self.SHAPE_VIOLATION)
+    root = ['--root', str(tmp_path)]
+    assert dclint_main.run(root + ['--update-baseline']) == 0
+    # Pushing the finding down the file must not invalidate its entry.
+    f.write_text('import os\n\n\n' + f.read_text())
+    assert dclint_main.run(root) == 0
+
+  def test_json_format(self, tmp_path, capsys):
+    make_tree(tmp_path, 'deepconsensus_tpu/inference/x.py',
+              self.SHAPE_VIOLATION)
+    assert dclint_main.run(['--root', str(tmp_path),
+                            '--format', 'json']) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['new'] and payload['new'][0]['rule'] == (
+        'shape-literals')
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide gates
+# ---------------------------------------------------------------------------
+
+
+class TestRepoGates:
+
+  def test_repo_lints_clean_against_committed_baseline(self, capsys):
+    assert dclint_main.run([]) == 0, capsys.readouterr().out
+
+  def test_no_zero_baseline_rule_findings_in_repo(self):
+    findings = core.run_lint(str(REPO_ROOT))
+    burned_down = [f for f in findings
+                   if f.rule in dclint_main.ZERO_BASELINE_RULES
+                   or f.rule == 'jit-hazards']
+    assert burned_down == [], '\n'.join(f.format() for f in burned_down)
+
+  def test_committed_baseline_has_no_zero_baseline_rules(self):
+    baseline = json.loads(
+        (REPO_ROOT / 'tools' / 'dclint' / 'baseline.json').read_text())
+    for rule in dclint_main.ZERO_BASELINE_RULES:
+      assert not baseline['rules'].get(rule), (
+          f'{rule} findings must be fixed, never baselined')
+
+  def test_cli_lint_subcommand(self, capsys):
+    from deepconsensus_tpu import cli
+
+    assert cli.main(['lint']) == 0, capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Config stays in sync with the real fault modules
+# ---------------------------------------------------------------------------
+
+
+def public_names(module):
+  return {
+      name for name in vars(module)
+      if not name.startswith('_')
+      and getattr(getattr(module, name), '__module__', module.__name__)
+      == module.__name__
+  }
+
+
+class TestConfigSync:
+
+  def test_fault_types_exist_and_are_exceptions(self):
+    import deepconsensus_tpu.faults as shared
+    import deepconsensus_tpu.inference.faults as inf
+
+    for name in dclint_config.FAULT_TYPES:
+      obj = getattr(shared, name, None) or getattr(inf, name, None)
+      assert obj is not None, f'FAULT_TYPES entry {name} no longer exists'
+      assert issubclass(obj, BaseException), name
+
+  def test_shared_fault_taxonomy_covered(self):
+    """Every exception class in the shared faults module is in
+    FAULT_TYPES (adding a fault type must extend the checker too)."""
+    import deepconsensus_tpu.faults as shared
+
+    taxonomy = {
+        name for name in public_names(shared)
+        if isinstance(getattr(shared, name), type)
+        and issubclass(getattr(shared, name), BaseException)
+    }
+    assert taxonomy <= set(dclint_config.FAULT_TYPES), (
+        taxonomy - set(dclint_config.FAULT_TYPES))
+
+  def test_inference_faults_reexports_shared_surface(self):
+    """The inference-side shim must re-export every public name of the
+    shared faults module as the identical object (no drift)."""
+    import deepconsensus_tpu.faults as shared
+    import deepconsensus_tpu.inference.faults as inf
+
+    missing = {
+        name for name in public_names(shared)
+        if getattr(inf, name, None) is not getattr(shared, name)
+    }
+    assert missing == set(), (
+        f'inference.faults re-export shim drifted: {sorted(missing)}')

@@ -174,6 +174,68 @@ def test_concurrent_clients_byte_identical_to_solo(serve_ctx, params):
   assert stats['n_model_packs'] < sum(3 + i % 4 for i in range(10))
 
 
+def test_metricz_hammer_during_soak_exact_counters(serve_ctx, params):
+  """Regression for the metrics/model-loop race: /metricz used to
+  sort the latency deque while _finish appended to it ("deque mutated
+  during iteration"). N reader threads hammer /metricz through a full
+  soak batch; every read must succeed and the final counters must be
+  exact — no torn reads, no lost increments."""
+  ctx = serve_ctx()
+  assert ctx.client.wait_ready(10)
+  ctx.control.dispatch_delay = 0.002  # keep latencies flowing
+  n_requests = 24
+  stop = threading.Event()
+  reader_errors = []
+  n_reads = [0]
+
+  def hammer():
+    client = ServeClient(port=ctx.port, timeout=30)
+    while not stop.is_set():
+      try:
+        m = client.metricz()
+        # Counters must always be internally coherent mid-soak.
+        assert 0 <= m['faults']['n_requests'] <= n_requests
+        assert 0 <= m['latency']['n'] <= n_requests
+        n_reads[0] += 1
+      except Exception as e:  # noqa: BLE001 - reported via the assert
+        reader_errors.append(e)
+        return
+
+  readers = [threading.Thread(target=hammer) for _ in range(4)]
+  for t in readers:
+    t.start()
+
+  submit_errors = []
+
+  def submit(base):
+    client = ServeClient(port=ctx.port, timeout=30)
+    for i in range(n_requests // 4):
+      try:
+        resp = client.polish(**_mol(params, f'm/{base}_{i}/ccs'))
+        assert resp['status'] == 'ok'
+      except Exception as e:  # noqa: BLE001
+        submit_errors.append(e)
+
+  submitters = [threading.Thread(target=submit, args=(w,))
+                for w in range(4)]
+  for t in submitters:
+    t.start()
+  for t in submitters:
+    t.join(60)
+  stop.set()
+  for t in readers:
+    t.join(30)
+
+  assert not submit_errors, submit_errors[:3]
+  assert not reader_errors, reader_errors[:3]
+  assert n_reads[0] > 0
+  m = ctx.client.metricz()
+  assert m['faults']['n_requests'] == n_requests
+  assert m['latency']['n'] == n_requests
+  assert m['faults']['n_quarantined_by_request'] == 0
+  assert m['faults']['n_deadline_cancelled'] == 0
+
+
 def test_garbage_body_rejected_400(serve_ctx, params):
   ctx = serve_ctx()
   status = client_lib.send_garbage('127.0.0.1', ctx.port)

@@ -1,0 +1,31 @@
+"""dclint: repo-native static analysis for DeepConsensus-TPU.
+
+Four AST checkers enforce invariants that PRs 1-6 paid for:
+
+* ``typed-faults``   — data-plane raises must be typed ``faults.py``
+  errors; broad ``except Exception:`` handlers must re-raise or route
+  the exception to quarantine / dead-letter.
+* ``jit-hazards``    — no ``jax.jit`` construction inside loops or
+  per-batch hot functions, no Python-scalar positional args at jitted
+  call sites, no implicit device->host syncs in the model loop or the
+  serve service thread.
+* ``guarded-by``     — shared mutable state reached from more than one
+  thread entry point must carry a ``# guarded by: self._lock``
+  declaration (and only be touched inside ``with self._lock:``) or an
+  explicit ``# dclint: lock-free (reason)`` annotation.
+* ``shape-literals`` — no new hardcoded 100 / L<=128 window-shape
+  literals outside ``models/config.py``.
+
+Entry points: ``python -m tools.dclint`` or ``dctpu lint``.
+See docs/development.md for the rules and the baseline workflow.
+"""
+
+from tools.dclint.core import (  # noqa: F401
+    Finding,
+    load_baseline,
+    run_lint,
+    save_baseline,
+    split_findings,
+)
+
+RULES = ('typed-faults', 'jit-hazards', 'guarded-by', 'shape-literals')

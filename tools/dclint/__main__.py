@@ -1,0 +1,108 @@
+"""CLI for dclint: ``python -m tools.dclint`` or ``dctpu lint``.
+
+Exit codes: 0 = no findings outside the committed baseline,
+1 = new findings (or --strict-baseline violations), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from tools.dclint import core
+
+# Rules whose baseline must stay empty: violations get fixed, not
+# suppressed (see ISSUE 7 acceptance criteria / docs/development.md).
+ZERO_BASELINE_RULES = ('typed-faults', 'guarded-by')
+
+
+def default_root() -> str:
+  return os.path.dirname(os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+  p = argparse.ArgumentParser(
+      prog='dctpu lint',
+      description='AST static analysis: typed-faults, jit-hazards, '
+                  'guarded-by, shape-literals.')
+  p.add_argument('paths', nargs='*',
+                 help='files/dirs to lint (default: deepconsensus_tpu/ '
+                      'under --root)')
+  p.add_argument('--root', default=None,
+                 help='repository root (default: autodetected from '
+                      'the tools/ package location)')
+  p.add_argument('--baseline', default=None,
+                 help='baseline JSON (default: '
+                      '<root>/tools/dclint/baseline.json)')
+  p.add_argument('--update-baseline', action='store_true',
+                 help='rewrite the baseline with the current findings '
+                      'and exit 0 (refuses to baseline '
+                      f'{"/".join(ZERO_BASELINE_RULES)} findings)')
+  p.add_argument('--no-baseline', action='store_true',
+                 help='ignore the baseline: report every finding and '
+                      'fail if any exist')
+  p.add_argument('--format', choices=('text', 'json'), default='text')
+  return p
+
+
+def run(argv: Optional[Sequence[str]] = None,
+        stdout=None) -> int:
+  out = stdout or sys.stdout
+  args = build_parser().parse_args(argv)
+  root = os.path.abspath(args.root or default_root())
+  baseline_path = args.baseline or os.path.join(
+      root, 'tools', 'dclint', 'baseline.json')
+
+  findings = core.run_lint(root, args.paths or None)
+  baseline = {} if args.no_baseline else core.load_baseline(
+      baseline_path)
+  new, old, stale = core.split_findings(findings, baseline)
+
+  if args.update_baseline:
+    blocked = [f for f in findings if f.rule in ZERO_BASELINE_RULES]
+    if blocked:
+      for f in blocked:
+        print(f.format(), file=out)
+      print(f'dclint: refusing to baseline {len(blocked)} '
+            f'{"/".join(ZERO_BASELINE_RULES)} finding(s) — fix them '
+            '(see docs/development.md)', file=out)
+      return 1
+    core.save_baseline(baseline_path, findings)
+    print(f'dclint: baseline updated with {len(findings)} finding(s) '
+          f'-> {baseline_path}', file=out)
+    return 0
+
+  if args.format == 'json':
+    payload = {
+        'new': [vars(f) for f in new],
+        'baselined': [vars(f) for f in old],
+        'stale_baseline_entries': stale,
+    }
+    json.dump(payload, out, indent=2)
+    out.write('\n')
+  else:
+    for f in new:
+      print(f.format(), file=out)
+    if stale:
+      print(f'dclint: note: {len(stale)} stale baseline entr'
+            f'{"y" if len(stale) == 1 else "ies"} (fixed findings) — '
+            'run `dctpu lint --update-baseline` to prune', file=out)
+    counts = collections.Counter(f.rule for f in findings)
+    summary = ', '.join(f'{r}={counts.get(r, 0)}' for r in sorted(
+        counts)) or 'none'
+    print(f'dclint: {len(new)} new finding(s), {len(old)} baselined '
+          f'({summary})', file=out)
+  return 1 if new else 0
+
+
+def main() -> None:
+  sys.exit(run())
+
+
+if __name__ == '__main__':
+  main()

@@ -1,0 +1,171 @@
+"""Repo-specific configuration for the dclint checkers.
+
+Everything path-like is a repo-relative posix path (or a prefix of
+one).  Checkers decide whether a file is in scope by matching these
+prefixes, so fixture tests can exercise a checker by handing it a
+virtual path like ``deepconsensus_tpu/io/x.py``.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Shared
+# ---------------------------------------------------------------------------
+
+# Files dclint walks when given a directory.  tests/ and tools/ are
+# deliberately out of scope: fixtures seed violations on purpose.
+WALK_ROOTS = ('deepconsensus_tpu',)
+EXCLUDE_PARTS = ('__pycache__',)
+
+# ---------------------------------------------------------------------------
+# typed-faults
+# ---------------------------------------------------------------------------
+
+# Data-plane modules where every `raise` must be a typed fault.
+TYPED_FAULTS_SCOPE = (
+    'deepconsensus_tpu/io/',
+    'deepconsensus_tpu/inference/',
+    'deepconsensus_tpu/serve/',
+    'deepconsensus_tpu/models/data.py',
+)
+
+# The typed fault taxonomy (deepconsensus_tpu/faults.py plus the
+# inference-side additions in inference/faults.py).  Kept static so the
+# checker behaves identically on fixture trees; tests/test_dclint.py
+# asserts this list stays in sync with the real modules.
+FAULT_TYPES = frozenset({
+    # deepconsensus_tpu/faults.py
+    'CorruptInputError',
+    'ServeRejection',
+    'BackpressureError',
+    'DrainingError',
+    'DeadlineExceededError',
+    'BadRequestError',
+    'RequestTooLargeError',
+    'CrashLoopError',
+    'NonFiniteTrainingError',
+    # deepconsensus_tpu/inference/faults.py
+    'ZmwFault',
+    'WatchdogTimeout',
+})
+
+# Exceptions that are control flow / interop, not fault reporting.
+CONTROL_FLOW_EXCEPTIONS = frozenset({
+    'StopIteration',
+    'StopAsyncIteration',
+    'KeyboardInterrupt',
+    'SystemExit',
+    'NotImplementedError',
+})
+
+# Local helper functions that construct-and-return a typed fault
+# (`raise corrupt(...)` in io/bam.py).
+FAULT_CONSTRUCTOR_HELPERS = frozenset({'corrupt'})
+
+# Module-local exception classes that are deliberately NOT faults.py
+# types.  Each entry documents why.
+TYPED_FAULTS_EXTRA_ALLOWED = {
+    'ServeClientError': (
+        'client-side transport error: raised in the client process, '
+        'never crosses the serve data plane'),
+}
+
+# A broad `except Exception:` handler passes if it re-raises, or if it
+# hands the caught exception to a call whose dotted name contains one
+# of these markers (quarantine.record_failure, dead-letter writers,
+# _on_pack_failure, emit_queue.put, ...).
+ROUTING_NAME_MARKERS = (
+    'quarantine', 'record', 'dead_letter', 'fail', 'put', 'handle',
+)
+
+# ---------------------------------------------------------------------------
+# jit-hazards
+# ---------------------------------------------------------------------------
+
+# Files whose hot functions are scanned for host syncs / jit traps.
+JIT_SCOPE = (
+    'deepconsensus_tpu/inference/engine.py',
+    'deepconsensus_tpu/inference/runner.py',
+    'deepconsensus_tpu/serve/service.py',
+)
+
+# Per-batch functions: called once (or more) per dispatched pack, so a
+# jax.jit construction or an implicit device->host sync here hits the
+# continuous-batching latency directly.
+HOT_FUNCTIONS = {
+    'deepconsensus_tpu/inference/engine.py': frozenset({
+        'add', '_cut_packs', '_dispatch', '_drain_one', 'flush',
+        'submit', 'submit_formatted',
+    }),
+    'deepconsensus_tpu/inference/runner.py': frozenset({
+        'dispatch', 'finalize', 'predict',
+    }),
+    'deepconsensus_tpu/serve/service.py': frozenset({
+        '_model_loop', '_ingest', '_deliver', '_process_retries',
+        '_finish',
+    }),
+}
+
+# Calls whose results live on device: assigning from one of these makes
+# the target a device value for host-sync tracking.  Matched on the
+# last dotted segment.
+DEVICE_SOURCE_CALLS = frozenset({
+    '_jit_forward', 'device_put', 'dispatch',
+})
+
+# Function parameters known to carry device values (the engine hands
+# `ModelRunner.dispatch` results straight to `finalize`).
+DEVICE_PARAMS = {
+    ('deepconsensus_tpu/inference/runner.py', 'finalize'): frozenset(
+        {'dispatched'}),
+}
+
+# Host-materialising calls: flagged when applied to a device value.
+HOST_SYNC_CALLS = frozenset({'float', 'int', 'bool', 'asarray', 'array'})
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+GUARDED_BY_SCOPE = (
+    'deepconsensus_tpu/serve/service.py',
+    'deepconsensus_tpu/inference/engine.py',
+    'deepconsensus_tpu/inference/runner.py',
+)
+
+# Attribute initialisers of these types are synchronisation primitives
+# or thread-safe containers themselves; they never need a guard.
+THREADSAFE_INIT_CALLS = frozenset({
+    'Lock', 'RLock', 'Condition', 'Event', 'Semaphore',
+    'BoundedSemaphore', 'Barrier', 'Queue', 'SimpleQueue',
+    'LifoQueue', 'PriorityQueue',
+})
+
+# Method calls that mutate their receiver (used to classify closure
+# variable accesses as writes).
+MUTATING_METHODS = frozenset({
+    'append', 'appendleft', 'extend', 'insert', 'add', 'update',
+    'pop', 'popleft', 'remove', 'discard', 'clear', 'setdefault',
+    'record',
+})
+
+# ---------------------------------------------------------------------------
+# shape-literals
+# ---------------------------------------------------------------------------
+
+SHAPE_LITERAL_VALUES = frozenset({100, 128})
+
+# The one place window-shape defaults may live.
+SHAPE_LITERALS_EXEMPT = ('deepconsensus_tpu/models/config.py',)
+
+# Keyword arguments whose value being 100/128 marks a window-shape
+# assumption.
+SHAPE_KEYWORDS = frozenset({
+    'max_length', 'example_width', 'width', 'window_size',
+    'max_window_len', 'padded_len', 'window_len', 'max_passes',
+})
+
+# Name fragments that mark a comparison / assignment target as
+# shape-ish (`if length > 100`, `max_length = 100`, `L <= 128`).
+SHAPE_NAME_FRAGMENTS = ('length', 'width', 'window')
+SHAPE_SHORT_NAMES = frozenset({'L', 'l'})

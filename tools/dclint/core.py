@@ -1,0 +1,261 @@
+"""dclint core: findings, inline suppressions, baselines, file walking.
+
+Baseline fingerprints are deliberately line-number independent:
+``sha1(rule :: path :: stripped-line-text :: occurrence-index)``.  An
+edit elsewhere in the file moves a legacy finding without invalidating
+its baseline entry; only changing the offending line itself (or adding
+a second identical one) produces a *new* finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.dclint import config
+
+ALLOW_RE = re.compile(
+    r'#\s*dclint:\s*allow=([\w,-]+)(?:\s*\((?P<reason>[^)]*)\))?')
+LOCK_FREE_RE = re.compile(
+    r'#\s*dclint:\s*lock-free(?:\s*\((?P<reason>[^)]*)\))?')
+GUARDED_BY_RE = re.compile(r'#\s*guarded by:\s*(?P<lock>[\w.]+)')
+
+
+@dataclasses.dataclass
+class Finding:
+  rule: str
+  path: str            # repo-relative posix path
+  line: int            # 1-based
+  message: str
+  fingerprint: str = ''
+
+  def format(self) -> str:
+    return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+
+class SourceFile:
+  """A parsed source file plus its per-line inline annotations."""
+
+  def __init__(self, path: str, source: str):
+    self.path = path
+    self.source = source
+    self.lines = source.splitlines()
+    self.tree = ast.parse(source, filename=path)
+    # line number -> set of rules allowed on that line
+    self.allows: Dict[int, set] = {}
+    # line number -> reason (or '') for `# dclint: lock-free`
+    self.lock_free: Dict[int, str] = {}
+    # line number -> lock expression for `# guarded by: self._lock`
+    self.guarded_by: Dict[int, str] = {}
+    for i, text in enumerate(self.lines, start=1):
+      m = ALLOW_RE.search(text)
+      if m:
+        self.allows[i] = set(p.strip() for p in m.group(1).split(','))
+      m = LOCK_FREE_RE.search(text)
+      if m:
+        self.lock_free[i] = m.group('reason') or ''
+      m = GUARDED_BY_RE.search(text)
+      if m:
+        self.guarded_by[i] = m.group('lock')
+
+  def allowed(self, rule: str, line: int) -> bool:
+    """True if `rule` is suppressed at `line`: on the line itself or
+    in the contiguous comment block directly above it (multi-line
+    reasons are encouraged)."""
+    if rule in self.allows.get(line, ()):
+      return True
+    ln = line - 1
+    while ln >= 1 and self.line_text(ln).startswith('#'):
+      if rule in self.allows.get(ln, ()):
+        return True
+      ln -= 1
+    return False
+
+  def line_text(self, line: int) -> str:
+    if 1 <= line <= len(self.lines):
+      return self.lines[line - 1].strip()
+    return ''
+
+
+def in_scope(path: str, prefixes: Sequence[str]) -> bool:
+  return any(path == p or path.startswith(p) for p in prefixes)
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints / baseline
+# ---------------------------------------------------------------------------
+
+
+def assign_fingerprints(findings: List[Finding],
+                        sources: Dict[str, SourceFile]) -> None:
+  """Fill in line-number-independent fingerprints in place."""
+  by_key: Dict[Tuple[str, str, str], List[Finding]] = {}
+  for f in findings:
+    src = sources.get(f.path)
+    text = src.line_text(f.line) if src else ''
+    by_key.setdefault((f.rule, f.path, text), []).append(f)
+  for (rule, path, text), group in by_key.items():
+    group.sort(key=lambda f: f.line)
+    for idx, f in enumerate(group):
+      raw = f'{rule}::{path}::{text}::{idx}'
+      f.fingerprint = hashlib.sha1(raw.encode('utf-8')).hexdigest()[:16]
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+  """Return {fingerprint: entry}.  Missing file -> empty baseline."""
+  if not os.path.exists(path):
+    return {}
+  with open(path, 'r', encoding='utf-8') as fh:
+    data = json.load(fh)
+  out: Dict[str, dict] = {}
+  for rule, entries in data.get('rules', {}).items():
+    for entry in entries:
+      out[entry['fingerprint']] = dict(entry, rule=rule)
+  return out
+
+
+def save_baseline(path: str, findings: Sequence[Finding]) -> None:
+  rules: Dict[str, list] = {}
+  for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+    rules.setdefault(f.rule, []).append({
+        'fingerprint': f.fingerprint,
+        'path': f.path,
+        'message': f.message,
+    })
+  payload = {
+      'version': 1,
+      'note': ('Legacy dclint findings, tracked but not fatal. '
+               'Regenerate with `dctpu lint --update-baseline`. '
+               'typed-faults and guarded-by must stay empty: fix '
+               'those, do not baseline them.'),
+      'rules': rules,
+  }
+  os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+  with open(path, 'w', encoding='utf-8') as fh:
+    json.dump(payload, fh, indent=2, sort_keys=True)
+    fh.write('\n')
+
+
+def split_findings(
+    findings: Sequence[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[Finding], List[dict]]:
+  """Split into (new, baselined, stale-baseline-entries)."""
+  seen = set()
+  new: List[Finding] = []
+  old: List[Finding] = []
+  for f in findings:
+    if f.fingerprint in baseline:
+      seen.add(f.fingerprint)
+      old.append(f)
+    else:
+      new.append(f)
+  stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+  return new, old, stale
+
+
+# ---------------------------------------------------------------------------
+# Walking / running
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(root: str,
+                  paths: Optional[Sequence[str]] = None) -> Iterable[str]:
+  """Yield repo-relative posix paths of Python files to lint."""
+  rels: List[str] = []
+  if paths:
+    for p in paths:
+      abs_p = p if os.path.isabs(p) else os.path.join(root, p)
+      if os.path.isdir(abs_p):
+        rels.extend(_walk_dir(root, abs_p))
+      elif abs_p.endswith('.py'):
+        rels.append(os.path.relpath(abs_p, root).replace(os.sep, '/'))
+  else:
+    for wr in config.WALK_ROOTS:
+      abs_p = os.path.join(root, wr)
+      if os.path.isdir(abs_p):
+        rels.extend(_walk_dir(root, abs_p))
+  return sorted(set(rels))
+
+
+def _walk_dir(root: str, abs_dir: str) -> List[str]:
+  out = []
+  for dirpath, dirnames, filenames in os.walk(abs_dir):
+    dirnames[:] = [d for d in dirnames if d not in config.EXCLUDE_PARTS]
+    for fn in filenames:
+      if fn.endswith('.py'):
+        rel = os.path.relpath(os.path.join(dirpath, fn), root)
+        out.append(rel.replace(os.sep, '/'))
+  return out
+
+
+def load_source(root: str, rel_path: str) -> Optional[SourceFile]:
+  try:
+    with open(os.path.join(root, rel_path), 'r', encoding='utf-8') as fh:
+      return SourceFile(rel_path, fh.read())
+  except (OSError, SyntaxError, UnicodeDecodeError):
+    return None
+
+
+def run_lint(root: str,
+             paths: Optional[Sequence[str]] = None) -> List[Finding]:
+  """Run all four checkers over `root`, fingerprints assigned."""
+  # Local imports: the checker modules import core for SourceFile.
+  from tools.dclint import guarded_by
+  from tools.dclint import jit_hazards
+  from tools.dclint import shape_literals
+  from tools.dclint import typed_faults
+
+  findings: List[Finding] = []
+  sources: Dict[str, SourceFile] = {}
+  for rel in iter_py_files(root, paths):
+    src = load_source(root, rel)
+    if src is None:
+      continue
+    sources[rel] = src
+    findings.extend(typed_faults.check(src))
+    findings.extend(jit_hazards.check(src))
+    findings.extend(guarded_by.check(src))
+    findings.extend(shape_literals.check(src))
+  findings.sort(key=lambda f: (f.path, f.line, f.rule))
+  assign_fingerprints(findings, sources)
+  return findings
+
+
+def add_parents(tree: ast.AST) -> None:
+  """Annotate every node with a `.dclint_parent` backlink."""
+  for node in ast.walk(tree):
+    for child in ast.iter_child_nodes(node):
+      child.dclint_parent = node  # type: ignore[attr-defined]
+
+
+def parents(node: ast.AST) -> Iterable[ast.AST]:
+  cur = getattr(node, 'dclint_parent', None)
+  while cur is not None:
+    yield cur
+    cur = getattr(cur, 'dclint_parent', None)
+
+
+def dotted_name(node: ast.AST) -> str:
+  """'self._quarantine.record_failure' for nested Attribute/Name."""
+  parts: List[str] = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if isinstance(node, ast.Name):
+    parts.append(node.id)
+  elif isinstance(node, ast.Call):
+    parts.append(dotted_name(node.func))
+  return '.'.join(reversed(parts))
+
+
+def last_segment(node: ast.AST) -> str:
+  if isinstance(node, ast.Attribute):
+    return node.attr
+  if isinstance(node, ast.Name):
+    return node.id
+  return ''

@@ -1,0 +1,470 @@
+"""guarded-by checker: lock discipline for multi-threaded state.
+
+Two analyses over the configured files:
+
+* **Class analysis** — for every class that constructs a
+  ``threading.Thread``, build the intra-class call graph, group
+  methods into thread entry points (each ``Thread(target=self.X)``
+  plus one group for all public methods, which handler threads call
+  concurrently), and find attributes written outside ``__init__`` that
+  are reached from more than one group (or mutated from the public
+  group at all, since public methods already run on many threads).
+  Each such attribute must carry ``# guarded by: self._lock`` on its
+  ``__init__`` assignment — in which case every access outside
+  ``__init__`` must sit lexically inside ``with self._lock:`` — or an
+  explicit ``# dclint: lock-free (reason)`` annotation.
+
+* **Closure analysis** — for every function that spawns a
+  ``threading.Thread`` targeting a locally-defined function, closure
+  variables written after initialisation and touched by more than one
+  group (main body / each thread body, through nested calls) need the
+  same annotation on their initialising assignment.
+
+Lock/Event/Queue-typed attributes are exempt: they are the
+synchronisation primitives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.dclint import config
+from tools.dclint import core
+
+RULE = 'guarded-by'
+
+Access = Tuple[int, bool, ast.AST]  # (line, is_write, node)
+
+
+def _annotation_at(src: core.SourceFile, line: int,
+                   end_line: Optional[int] = None
+                   ) -> Tuple[Optional[str], bool]:
+  """(lock expression, lock_free?) declared on the statement spanning
+  `line`..`end_line`, or in the comment block directly above it."""
+  candidates = list(range(line, (end_line or line) + 1))
+  ln = line - 1
+  while ln >= 1 and src.line_text(ln).startswith('#'):
+    candidates.append(ln)
+    ln -= 1
+  for ln in candidates:
+    if ln in src.guarded_by:
+      return src.guarded_by[ln], False
+    if ln in src.lock_free:
+      return None, True
+  return None, False
+
+
+def _under_lock(node: ast.AST, lock_expr: str) -> bool:
+  for p in core.parents(node):
+    if isinstance(p, ast.With):
+      for item in p.items:
+        if core.dotted_name(item.context_expr) == lock_expr:
+          return True
+  return False
+
+
+def _thread_targets(fn: ast.AST) -> List[ast.AST]:
+  """`target=` expressions of threading.Thread(...) calls in `fn`,
+  excluding nested function bodies (the class analysis looks at whole
+  methods; the closure analysis handles nesting itself)."""
+  out = []
+  for node in ast.walk(fn):
+    if (isinstance(node, ast.Call)
+        and core.last_segment(node.func) == 'Thread'):
+      for kw in node.keywords:
+        if kw.arg == 'target':
+          out.append(kw.value)
+  return out
+
+
+# ---------------------------------------------------------------------------
+# Class analysis
+# ---------------------------------------------------------------------------
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+  if (isinstance(node, ast.Attribute)
+      and isinstance(node.value, ast.Name) and node.value.id == 'self'):
+    return node.attr
+  return None
+
+
+def _method_accesses(method: ast.AST) -> Dict[str, List[Access]]:
+  """self.X accesses in a method: (line, is_write, node)."""
+  acc: Dict[str, List[Access]] = {}
+
+  def add(name: str, node: ast.AST, write: bool):
+    acc.setdefault(name, []).append((node.lineno, write, node))
+
+  for node in ast.walk(method):
+    name = _self_attr(node)
+    if name is not None:
+      write = isinstance(node.ctx, (ast.Store, ast.Del))
+      # self.X.append(...) / self.X.update(...) mutates X.
+      parent = getattr(node, 'dclint_parent', None)
+      if (isinstance(parent, ast.Attribute)
+          and parent.attr in config.MUTATING_METHODS
+          and isinstance(getattr(parent, 'dclint_parent', None),
+                         ast.Call)):
+        write = True
+      # self.X[k] = v / del self.X[k] mutates X.
+      if (isinstance(parent, ast.Subscript)
+          and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        write = True
+      add(name, node, write)
+  return acc
+
+
+def _check_class(src: core.SourceFile,
+                 cls: ast.ClassDef) -> List[core.Finding]:
+  methods = {n.name: n for n in cls.body
+             if isinstance(n, ast.FunctionDef)}
+  targets: Set[str] = set()
+  spawns = False
+  for m in methods.values():
+    for t in _thread_targets(m):
+      spawns = True
+      name = _self_attr(t) or core.last_segment(t)
+      if name in methods:
+        targets.add(name)
+  if not spawns:
+    return []
+
+  # Call graph: method -> self-methods it calls.
+  calls: Dict[str, Set[str]] = {}
+  for name, m in methods.items():
+    callees = set()
+    for node in ast.walk(m):
+      if isinstance(node, ast.Call):
+        attr = _self_attr(node.func)
+        if attr in methods:
+          callees.add(attr)
+    calls[name] = callees
+
+  def reachable(roots: Set[str]) -> Set[str]:
+    seen, stack = set(), list(roots & set(methods))
+    while stack:
+      cur = stack.pop()
+      if cur in seen:
+        continue
+      seen.add(cur)
+      stack.extend(calls.get(cur, ()))
+    return seen
+
+  public = {n for n in methods
+            if not n.startswith('_') or n in ('__call__',)}
+  groups: Dict[str, Set[str]] = {'public': reachable(public)}
+  for t in sorted(targets):
+    groups[t] = reachable({t})
+
+  accesses = {name: _method_accesses(m) for name, m in methods.items()}
+  init_acc = accesses.get('__init__', {})
+
+  # Attribute inventory: which groups touch it, where it's written.
+  attr_groups: Dict[str, Set[str]] = {}
+  attr_written: Dict[str, bool] = {}
+  attr_public_write: Dict[str, bool] = {}
+  for gname, members in groups.items():
+    for m in members:
+      if m == '__init__':
+        continue
+      for attr, accs in accesses.get(m, {}).items():
+        attr_groups.setdefault(attr, set()).add(gname)
+        if any(w for (_, w, _) in accs):
+          attr_written[attr] = True
+          if gname == 'public':
+            attr_public_write[attr] = True
+
+  findings: List[core.Finding] = []
+  for attr in sorted(attr_groups):
+    if not attr_written.get(attr):
+      continue  # read-only after __init__
+    shared = (len(attr_groups[attr]) > 1
+              or attr_public_write.get(attr, False))
+    if not shared:
+      continue
+    # Find the __init__ assignment (annotation anchor + type exemption).
+    init_line = None
+    init_end = None
+    exempt = False
+    for stmt in ast.walk(methods.get('__init__', cls)):
+      if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+        tgts = (stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target])
+        for tgt in tgts:
+          if _self_attr(tgt) == attr:
+            if init_line is None:
+              init_line = stmt.lineno
+              init_end = getattr(stmt, 'end_lineno', stmt.lineno)
+            if (isinstance(stmt.value, ast.Call)
+                and core.last_segment(stmt.value.func)
+                in config.THREADSAFE_INIT_CALLS):
+              exempt = True
+    if exempt:
+      continue
+    anchor = init_line or min(
+        ln for g in groups.values() for m in g
+        for (ln, _, _) in accesses.get(m, {}).get(attr, [(10**9, 0, 0)])
+        if ln < 10**9)
+    lock_expr, lock_free = _annotation_at(src, anchor,
+                                          init_end or anchor)
+    if lock_free:
+      continue
+    if lock_expr is None:
+      if not src.allowed(RULE, anchor):
+        findings.append(core.Finding(
+            RULE, src.path, anchor,
+            f'shared mutable attribute `self.{attr}` of '
+            f'`{cls.name}` is reached from thread entry points '
+            f'{sorted(attr_groups[attr])} — declare '
+            '`# guarded by: self._lock` on its __init__ assignment '
+            'or annotate `# dclint: lock-free (reason)`'))
+      continue
+    # Declared guarded: every access outside __init__ must be inside
+    # `with <lock_expr>:`.
+    for m, accs in accesses.items():
+      if m == '__init__':
+        continue
+      for (ln, _w, node) in accs.get(attr, []):
+        if not _under_lock(node, lock_expr):
+          if not src.allowed(RULE, ln):
+            findings.append(core.Finding(
+                RULE, src.path, ln,
+                f'`self.{attr}` is declared `# guarded by: '
+                f'{lock_expr}` but this access in `{m}` is outside '
+                f'`with {lock_expr}:`'))
+  return findings
+
+
+# ---------------------------------------------------------------------------
+# Closure analysis
+# ---------------------------------------------------------------------------
+
+
+def _name_accesses(body_nodes: List[ast.AST],
+                   skip_defs: Set[ast.AST]) -> Dict[str, List[Access]]:
+  """Name accesses in `body_nodes`, not descending into `skip_defs`."""
+  acc: Dict[str, List[Access]] = {}
+
+  def visit(node: ast.AST):
+    if node in skip_defs:
+      return
+    if isinstance(node, ast.Name):
+      write = isinstance(node.ctx, (ast.Store, ast.Del))
+      parent = getattr(node, 'dclint_parent', None)
+      if (isinstance(parent, ast.Attribute)
+          and parent.attr in config.MUTATING_METHODS
+          and isinstance(getattr(parent, 'dclint_parent', None),
+                         ast.Call)):
+        write = True
+      if (isinstance(parent, ast.Subscript)
+          and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        write = True
+      acc.setdefault(node.id, []).append((node.lineno, write, node))
+    for child in ast.iter_child_nodes(node):
+      visit(child)
+
+  for n in body_nodes:
+    visit(n)
+  return acc
+
+
+def _function_locals(fn: ast.AST, all_defs: Set[ast.AST]) -> Set[str]:
+  """Names local to `fn` (params + stores), minus nonlocal/global
+  declarations — accesses to these are NOT closure accesses."""
+  args = fn.args
+  locs = {a.arg for a in (args.args + args.kwonlyargs
+                          + getattr(args, 'posonlyargs', []))}
+  for va in (args.vararg, args.kwarg):
+    if va is not None:
+      locs.add(va.arg)
+  escaping: Set[str] = set()
+
+  def visit(node: ast.AST):
+    if node is not fn and node in all_defs:
+      return
+    if isinstance(node, (ast.Nonlocal, ast.Global)):
+      escaping.update(node.names)
+    elif isinstance(node, ast.Name) and isinstance(
+        node.ctx, (ast.Store, ast.Del)):
+      locs.add(node.id)
+    elif isinstance(node, ast.ExceptHandler) and node.name:
+      locs.add(node.name)
+    for child in ast.iter_child_nodes(node):
+      visit(child)
+
+  visit(fn)
+  return locs - escaping
+
+
+def _init_assign(fn: ast.AST, all_defs: Set[ast.AST], var: str,
+                 line: int) -> Optional[ast.Assign]:
+  """The Assign at `line` in `fn` (outside nested defs) targeting
+  `var`, if that is how the variable is initialised."""
+  for node in ast.walk(fn):
+    if not (isinstance(node, ast.Assign) and node.lineno == line):
+      continue
+    if any(node in ast.walk(d) for d in all_defs):
+      continue
+    for tgt in node.targets:
+      for n in ast.walk(tgt):
+        if isinstance(n, ast.Name) and n.id == var:
+          return node
+  return None
+
+
+def _check_closures(src: core.SourceFile,
+                    fn: ast.FunctionDef) -> List[core.Finding]:
+  nested = {n.name: n for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn}
+  targets = set()
+  for t in _thread_targets(fn):
+    seg = core.last_segment(t)
+    if seg in nested:
+      targets.add(seg)
+  if not targets:
+    return []
+
+  # Call graph over nested defs (by bare name).
+  calls: Dict[str, Set[str]] = {}
+  for name, n in nested.items():
+    callees = set()
+    for node in ast.walk(n):
+      if (isinstance(node, ast.Call)
+          and isinstance(node.func, ast.Name)
+          and node.func.id in nested):
+        callees.add(node.func.id)
+    calls[name] = callees
+
+  def reachable(root: str) -> Set[str]:
+    seen, stack = set(), [root]
+    while stack:
+      cur = stack.pop()
+      if cur in seen:
+        continue
+      seen.add(cur)
+      stack.extend(calls.get(cur, ()))
+    return seen
+
+  all_defs = set(nested.values())
+  # Main group: fn body minus nested defs, plus nested defs it calls
+  # that are not thread targets... keep it simple: main = fn body
+  # (excluding all nested defs) plus nested non-target defs it calls.
+  main_callees: Set[str] = set()
+  for node in ast.walk(fn):
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+        and node.func.id in nested):
+      in_nested = any(node in ast.walk(n) for n in nested.values())
+      if not in_nested:
+        main_callees.add(node.func.id)
+  main_members = set()
+  for c in main_callees - targets:
+    main_members |= reachable(c)
+
+  def closure_accesses(member: ast.AST) -> Dict[str, List[Access]]:
+    """Accesses in `member` to names that are free there (true
+    closure accesses, not same-named locals)."""
+    sub = _name_accesses(list(ast.iter_child_nodes(member)), all_defs)
+    locs = _function_locals(member, all_defs)
+    return {k: v for k, v in sub.items() if k not in locs}
+
+  group_acc: Dict[str, Dict[str, List[Access]]] = {}
+  group_acc['main'] = _name_accesses(list(ast.iter_child_nodes(fn)),
+                                     all_defs)
+  for m in main_members:
+    for k, v in closure_accesses(nested[m]).items():
+      group_acc['main'].setdefault(k, []).extend(v)
+  for t in sorted(targets):
+    acc: Dict[str, List[Access]] = {}
+    for m in reachable(t):
+      for k, v in closure_accesses(nested[m]).items():
+        acc.setdefault(k, []).extend(v)
+    group_acc[t] = acc
+
+  # Writes in the main body before the first Thread construction are
+  # initialisation: publishing an object and then only reading it from
+  # the spawned threads is safe handoff, not sharing.
+  start_line = min((n.lineno for n in ast.walk(fn)
+                    if isinstance(n, ast.Call)
+                    and core.last_segment(n.func) == 'Thread'
+                    and not any(n in ast.walk(d) for d in all_defs)),
+                   default=0)
+
+  # Candidate closure vars: assigned in the main body (their first
+  # main write is the initialising assignment).
+  params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+  findings: List[core.Finding] = []
+  main = group_acc['main']
+  for var in sorted(main):
+    if var in nested or var in params:
+      continue
+    touching = [g for g, acc in group_acc.items() if var in acc]
+    if len(touching) < 2:
+      continue
+    main_writes = sorted(ln for (ln, w, _) in main[var] if w)
+    if not main_writes:
+      continue  # not defined in this closure (global/builtin)
+    init_line = main_writes[0]
+    # Queues / locks / events are the synchronisation primitives —
+    # exempt, mirroring the class-attribute exemption.
+    exempt = False
+    for ln in main_writes:
+      init_assign = _init_assign(fn, all_defs, var, ln)
+      if (init_assign is not None
+          and isinstance(init_assign.value, ast.Call)
+          and core.last_segment(init_assign.value.func)
+          in config.THREADSAFE_INIT_CALLS):
+        exempt = True
+    if exempt:
+      continue
+    # Post-init writes: main-body writes after the first Thread
+    # construction, plus any write from a non-main group.
+    post_init = sorted(
+        [ln for (ln, w, _) in main[var] if w and ln >= start_line]
+        + [ln for g in touching if g != 'main'
+           for (ln, w, _) in group_acc[g][var] if w])
+    if not post_init:
+      continue  # write-once config published before thread start
+    first_assign = _init_assign(fn, all_defs, var, init_line)
+    init_end = getattr(first_assign, 'end_lineno', init_line)
+    lock_expr, lock_free = _annotation_at(src, init_line, init_end)
+    if lock_free:
+      continue
+    if lock_expr is None:
+      if not src.allowed(RULE, init_line):
+        findings.append(core.Finding(
+            RULE, src.path, init_line,
+            f'closure variable `{var}` in `{fn.name}` is written '
+            f'after init and shared across thread groups '
+            f'{sorted(touching)} — annotate its initialisation with '
+            '`# guarded by: <lock>` or `# dclint: lock-free '
+            '(reason)`'))
+      continue
+    for g in touching:
+      for (ln, _w, node) in group_acc[g][var]:
+        if ln == init_line:
+          continue
+        if not _under_lock(node, lock_expr):
+          if not src.allowed(RULE, ln):
+            findings.append(core.Finding(
+                RULE, src.path, ln,
+                f'`{var}` is declared `# guarded by: {lock_expr}` '
+                f'but this access is outside `with {lock_expr}:`'))
+  return findings
+
+
+def check(src: core.SourceFile) -> List[core.Finding]:
+  if not core.in_scope(src.path, config.GUARDED_BY_SCOPE):
+    return []
+  core.add_parents(src.tree)
+  findings: List[core.Finding] = []
+  for node in ast.walk(src.tree):
+    if isinstance(node, ast.ClassDef):
+      findings.extend(_check_class(src, node))
+    elif isinstance(node, ast.FunctionDef):
+      if not any(isinstance(p, ast.ClassDef) for p in
+                 core.parents(node)):
+        findings.extend(_check_closures(src, node))
+  return findings

@@ -1,0 +1,201 @@
+"""jit-hazards checker.
+
+Inside the engine model loop and serve service thread (the configured
+hot functions), flag:
+
+* ``jax.jit`` / ``jax.pmap`` constructed inside a loop or a per-batch
+  hot function (each construction is a fresh compile cache);
+* Python-scalar / ``len(...)`` positional args at jitted call sites
+  (every new value retriggers compilation);
+* implicit device->host syncs: ``.item()``, ``float()/int()/bool()``
+  on device values, ``np.asarray``/``np.array`` of jit outputs.
+
+A deliberate sync (there is exactly one, in ``ModelRunner.finalize``)
+carries ``# dclint: allow=jit-hazards (reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tools.dclint import config
+from tools.dclint import core
+
+RULE = 'jit-hazards'
+
+_JIT_NAMES = ('jit', 'pmap')
+
+
+def _is_jit_construction(node: ast.Call) -> bool:
+  return core.last_segment(node.func) in _JIT_NAMES and (
+      isinstance(node.func, ast.Attribute)
+      or isinstance(node.func, ast.Name))
+
+
+def _enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+  for p in core.parents(node):
+    if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      return p
+  return None
+
+
+def _inside_loop(node: ast.AST, stop_at: Optional[ast.AST]) -> bool:
+  for p in core.parents(node):
+    if p is stop_at:
+      return False
+    if isinstance(p, (ast.For, ast.While, ast.AsyncFor)):
+      return True
+  return False
+
+
+def _jit_handles(tree: ast.AST) -> Set[str]:
+  """Names (last segment) bound to jax.jit(...) results anywhere in
+  the module: `fwd = jax.jit(f)`, `self._forward = jax.jit(f)`."""
+  handles: Set[str] = set()
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.Assign):
+      continue
+    if not (isinstance(node.value, ast.Call)
+            and _is_jit_construction(node.value)):
+      continue
+    for tgt in node.targets:
+      seg = core.last_segment(tgt)
+      if seg:
+        handles.add(seg)
+  return handles
+
+
+def _construction_findings(src: core.SourceFile,
+                           hot: Set[str]) -> List[core.Finding]:
+  out = []
+  for node in ast.walk(src.tree):
+    if not (isinstance(node, ast.Call) and _is_jit_construction(node)):
+      continue
+    fn = _enclosing_function(node)
+    fn_name = getattr(fn, 'name', '<module>')
+    if _inside_loop(node, fn):
+      msg = ('jax.jit constructed inside a loop — every iteration '
+             'starts a fresh compile cache; hoist the jit to '
+             '__init__ / module scope')
+    elif fn is not None and fn_name in hot:
+      msg = (f'jax.jit constructed inside per-batch hot function '
+             f'`{fn_name}` — compile once at init, not per batch')
+    else:
+      continue
+    if not src.allowed(RULE, node.lineno):
+      out.append(core.Finding(RULE, src.path, node.lineno, msg))
+  return out
+
+
+def _scalar_arg_findings(src: core.SourceFile,
+                         handles: Set[str]) -> List[core.Finding]:
+  out = []
+  if not handles:
+    return out
+  for node in ast.walk(src.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    if core.last_segment(node.func) not in handles:
+      continue
+    for arg in node.args:
+      bad = (isinstance(arg, ast.Constant)
+             and isinstance(arg.value, (int, float, bool))) or (
+                 isinstance(arg, ast.Call)
+                 and core.last_segment(arg.func) == 'len')
+      if bad and not src.allowed(RULE, node.lineno):
+        out.append(core.Finding(
+            RULE, src.path, node.lineno,
+            'Python-scalar positional arg at jitted call site '
+            f'`{core.dotted_name(node.func)}` — every distinct value '
+            'retriggers compilation; pass an array or bake the value '
+            'into the traced function'))
+  return out
+
+
+class _DeviceTracker:
+  """Intra-function dataflow: which local names hold device values."""
+
+  def __init__(self, src: core.SourceFile, fn: ast.FunctionDef,
+               handles: Set[str]):
+    self.device: Set[str] = set()
+    key = (src.path, fn.name)
+    self.device |= config.DEVICE_PARAMS.get(key, frozenset())
+    self.handles = handles
+    # Two passes over the body in source order reach a fixpoint for
+    # straight-line chains (a = dispatch(); b = a[0]; c = b).
+    for _ in range(2):
+      for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+          self._visit_assign(node)
+
+  def _value_is_device(self, value: ast.AST) -> bool:
+    if isinstance(value, ast.Call):
+      seg = core.last_segment(value.func)
+      return seg in config.DEVICE_SOURCE_CALLS or seg in self.handles
+    for n in ast.walk(value):
+      if isinstance(n, ast.Name) and n.id in self.device:
+        return True
+    return False
+
+  def _visit_assign(self, node: ast.Assign) -> None:
+    if not self._value_is_device(node.value):
+      return
+    for tgt in node.targets:
+      for n in ast.walk(tgt):
+        if isinstance(n, ast.Name):
+          self.device.add(n.id)
+
+  def expr_is_device(self, expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+      seg = core.last_segment(expr.func)
+      if seg in config.DEVICE_SOURCE_CALLS or seg in self.handles:
+        return True
+    for n in ast.walk(expr):
+      if isinstance(n, ast.Name) and n.id in self.device:
+        return True
+    return False
+
+
+def _host_sync_findings(src: core.SourceFile, hot: Set[str],
+                        handles: Set[str]) -> List[core.Finding]:
+  out = []
+  for fn in ast.walk(src.tree):
+    if not isinstance(fn, ast.FunctionDef) or fn.name not in hot:
+      continue
+    tracker = _DeviceTracker(src, fn, handles)
+    for node in ast.walk(fn):
+      if not isinstance(node, ast.Call):
+        continue
+      # `.item()` is always a sync when it appears in a hot function.
+      if (isinstance(node.func, ast.Attribute)
+          and node.func.attr == 'item' and not node.args):
+        if not src.allowed(RULE, node.lineno):
+          out.append(core.Finding(
+              RULE, src.path, node.lineno,
+              f'.item() inside per-batch hot function `{fn.name}` '
+              'forces a device->host sync and stalls the dispatch '
+              'pipeline'))
+        continue
+      seg = core.last_segment(node.func)
+      if seg in config.HOST_SYNC_CALLS and node.args:
+        if tracker.expr_is_device(node.args[0]):
+          if not src.allowed(RULE, node.lineno):
+            out.append(core.Finding(
+                RULE, src.path, node.lineno,
+                f'`{core.dotted_name(node.func)}(...)` materialises a '
+                f'device value on the host inside hot function '
+                f'`{fn.name}` — a deliberate sync needs '
+                '`# dclint: allow=jit-hazards (reason)`'))
+  return out
+
+
+def check(src: core.SourceFile) -> List[core.Finding]:
+  if not core.in_scope(src.path, config.JIT_SCOPE):
+    return []
+  core.add_parents(src.tree)
+  hot = set(config.HOT_FUNCTIONS.get(src.path, frozenset()))
+  handles = _jit_handles(src.tree)
+  return (_construction_findings(src, hot)
+          + _scalar_arg_findings(src, handles)
+          + _host_sync_findings(src, hot, handles))

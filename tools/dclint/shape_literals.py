@@ -1,0 +1,110 @@
+"""shape-literals checker.
+
+Flags hardcoded 100 / 128 window-shape literals outside
+``models/config.py`` — the forcing function for ROADMAP item 4's
+bucketed window lengths.  A literal is "shape-ish" when it appears as:
+
+* a shape keyword argument (``max_length=100``, ``example_width=100``),
+* a comparison against a length/width-named value
+  (``rows.shape[-1] <= 128``, ``if length > 100``),
+* an assignment / annotated assignment to a length/width/window-named
+  target (``max_length: int = 100``),
+* the default of a length/width/window-named parameter.
+
+Arbitrary numeric uses (``range(100)``, buffer sizes) are not flagged.
+Suppress with ``# dclint: allow=shape-literals (reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.dclint import config
+from tools.dclint import core
+
+RULE = 'shape-literals'
+
+
+def _shape_name(name: str) -> bool:
+  if name in config.SHAPE_SHORT_NAMES:
+    return True
+  low = name.lower()
+  return any(frag in low for frag in config.SHAPE_NAME_FRAGMENTS)
+
+
+def _name_of(node: ast.AST) -> str:
+  seg = core.last_segment(node)
+  if seg:
+    return seg
+  if isinstance(node, ast.Subscript):
+    return core.last_segment(node.value)
+  return ''
+
+
+def _context(lit: ast.Constant) -> Optional[str]:
+  """A description of the shape-ish context, or None."""
+  parent = getattr(lit, 'dclint_parent', None)
+  if parent is None:
+    return None
+  if isinstance(parent, ast.keyword) and parent.arg in (
+      config.SHAPE_KEYWORDS):
+    return f'keyword `{parent.arg}=`'
+  if isinstance(parent, ast.Compare):
+    sides = [parent.left] + list(parent.comparators)
+    for side in sides:
+      if side is lit:
+        continue
+      name = _name_of(side)
+      if name and (_shape_name(name) or name == 'shape'):
+        return f'comparison with `{name}`'
+      # rows.shape[-1] <= 128
+      if isinstance(side, ast.Subscript) and (
+          core.last_segment(side.value) == 'shape'):
+        return 'comparison with a `.shape[...]` value'
+  if isinstance(parent, ast.Assign):
+    for tgt in parent.targets:
+      name = _name_of(tgt)
+      if name and _shape_name(name):
+        return f'assignment to `{name}`'
+  if isinstance(parent, ast.AnnAssign):
+    name = _name_of(parent.target)
+    if name and _shape_name(name):
+      return f'assignment to `{name}`'
+  if isinstance(parent, ast.arguments):
+    # Default values: position maps from the tail of args.
+    defaults = parent.defaults
+    args = parent.args[-len(defaults):] if defaults else []
+    for a, d in zip(args, defaults):
+      if d is lit and _shape_name(a.arg):
+        return f'default of parameter `{a.arg}`'
+    for a, d in zip(parent.kwonlyargs, parent.kw_defaults):
+      if d is lit and _shape_name(a.arg):
+        return f'default of parameter `{a.arg}`'
+  return None
+
+
+def check(src: core.SourceFile) -> List[core.Finding]:
+  if core.in_scope(src.path, config.SHAPE_LITERALS_EXEMPT):
+    return []
+  if not src.path.startswith('deepconsensus_tpu/'):
+    return []
+  core.add_parents(src.tree)
+  out: List[core.Finding] = []
+  for node in ast.walk(src.tree):
+    if not (isinstance(node, ast.Constant)
+            and isinstance(node.value, int)
+            and not isinstance(node.value, bool)
+            and node.value in config.SHAPE_LITERAL_VALUES):
+      continue
+    ctx = _context(node)
+    if ctx is None:
+      continue
+    if src.allowed(RULE, node.lineno):
+      continue
+    out.append(core.Finding(
+        RULE, src.path, node.lineno,
+        f'hardcoded window-shape literal {node.value} ({ctx}) outside '
+        'models/config.py — route it through the model config so '
+        'bucketed window lengths (ROADMAP item 4) stay tractable'))
+  return out

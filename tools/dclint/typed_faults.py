@@ -1,0 +1,156 @@
+"""typed-faults checker.
+
+In the data plane (io/, inference/, serve/, models/data.py):
+
+* every ``raise`` must construct a typed fault from the ``faults.py``
+  taxonomy (or a module-local subclass of one, a registered helper
+  like ``corrupt(...)``, or a control-flow exception), or re-raise a
+  caught exception;
+* every broad ``except Exception:`` handler must re-raise or route the
+  caught exception to quarantine / dead-letter / a failure callback.
+
+Suppress a deliberate violation with
+``# dclint: allow=typed-faults (reason)`` on the offending line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from tools.dclint import config
+from tools.dclint import core
+
+RULE = 'typed-faults'
+
+_BROAD = ('Exception', 'BaseException')
+
+
+def _local_fault_classes(tree: ast.AST) -> Set[str]:
+  """Module-local classes that (transitively) subclass an allowed
+  exception type — e.g. TruncatedBamError(CorruptInputError)."""
+  allowed = set(config.FAULT_TYPES) | set(config.CONTROL_FLOW_EXCEPTIONS)
+  classes = {}
+  for node in ast.walk(tree):
+    if isinstance(node, ast.ClassDef):
+      classes[node.name] = [core.last_segment(b) for b in node.bases]
+  local: Set[str] = set()
+  changed = True
+  while changed:
+    changed = False
+    for name, bases in classes.items():
+      if name in local:
+        continue
+      if any(b in allowed or b in local for b in bases):
+        local.add(name)
+        changed = True
+  return local
+
+
+def _allowed_names(tree: ast.AST) -> Set[str]:
+  return (set(config.FAULT_TYPES)
+          | set(config.CONTROL_FLOW_EXCEPTIONS)
+          | set(config.TYPED_FAULTS_EXTRA_ALLOWED)
+          | _local_fault_classes(tree))
+
+
+def _is_reraise(exc: ast.AST) -> bool:
+  """`raise err` / `raise state.error` / `raise cell[0]` — a
+  previously-bound exception object, recognised by a lowercase leading
+  character (classes are CamelCase) or a subscript load."""
+  if isinstance(exc, ast.Subscript):
+    return True
+  seg = core.last_segment(exc)
+  return bool(seg) and not seg[0].isupper()
+
+
+def _raise_findings(src: core.SourceFile, allowed: Set[str]
+                    ) -> List[core.Finding]:
+  out = []
+  for node in ast.walk(src.tree):
+    if not isinstance(node, ast.Raise) or node.exc is None:
+      continue
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+      name = core.last_segment(exc.func)
+      ok = (name in allowed
+            or name in config.FAULT_CONSTRUCTOR_HELPERS
+            or (name and not name[0].isupper()
+                and name in config.FAULT_CONSTRUCTOR_HELPERS))
+    else:
+      name = core.last_segment(exc)
+      ok = _is_reraise(exc) or name in allowed
+    if ok or src.allowed(RULE, node.lineno):
+      continue
+    out.append(core.Finding(
+        RULE, src.path, node.lineno,
+        f'raise {name or ast.dump(exc)[:40]}(...) in the data plane: '
+        'use a typed faults.py error (CorruptInputError, ZmwFault, '
+        'ServeRejection, ...) or annotate with '
+        '`# dclint: allow=typed-faults (reason)`'))
+  return out
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+  t = handler.type
+  if t is None:
+    return True
+  if isinstance(t, (ast.Name, ast.Attribute)):
+    return core.last_segment(t) in _BROAD
+  if isinstance(t, ast.Tuple):
+    return any(core.last_segment(e) in _BROAD for e in t.elts)
+  return False
+
+
+def _name_used_in(node: ast.AST, name: str) -> bool:
+  return any(isinstance(n, ast.Name) and n.id == name
+             for n in ast.walk(node))
+
+
+def _handler_routes(handler: ast.ExceptHandler) -> bool:
+  """True if the handler re-raises or hands the exception to a
+  routing call (quarantine.record_failure, dead-letter writer,
+  _on_pack_failure, queue.put, ...)."""
+  for node in ast.walk(handler):
+    if isinstance(node, ast.Raise):
+      return True
+  for node in ast.walk(handler):
+    if not isinstance(node, ast.Call):
+      continue
+    dotted = core.dotted_name(node.func).lower()
+    if not any(m in dotted for m in config.ROUTING_NAME_MARKERS):
+      continue
+    if handler.name is None:
+      return True
+    if any(_name_used_in(arg, handler.name) for arg in node.args):
+      return True
+    if any(_name_used_in(kw.value, handler.name)
+           for kw in node.keywords):
+      return True
+  return False
+
+
+def _except_findings(src: core.SourceFile) -> List[core.Finding]:
+  out = []
+  for node in ast.walk(src.tree):
+    if not isinstance(node, ast.ExceptHandler):
+      continue
+    if not _is_broad_handler(node):
+      continue
+    if _handler_routes(node):
+      continue
+    if src.allowed(RULE, node.lineno):
+      continue
+    out.append(core.Finding(
+        RULE, src.path, node.lineno,
+        'broad `except Exception:` neither re-raises nor routes the '
+        'error to quarantine/dead-letter; route it or annotate with '
+        '`# dclint: allow=typed-faults (reason)`'))
+  return out
+
+
+def check(src: core.SourceFile) -> List[core.Finding]:
+  if not core.in_scope(src.path, config.TYPED_FAULTS_SCOPE):
+    return []
+  allowed = _allowed_names(src.tree)
+  return _raise_findings(src, allowed) + _except_findings(src)
