@@ -200,6 +200,14 @@ def _add_serve(sub):
                  '--config instead of a checkpoint (tests/demos).')
   p.add_argument('--config', default='transformer_learn_values+test',
                  help='Model preset for --random_init.')
+  p.add_argument('--dp', type=int, default=0,
+                 help='Data-parallel devices: each pack is dp-sharded '
+                 'over the mesh data axis (batch_size must divide '
+                 'evenly). 0 = single-device serving.')
+  p.add_argument('--tp', type=int, default=1,
+                 help='Tensor-parallel devices per replica (model-axis '
+                 'sharded attention/FFN weights); exported artifacts '
+                 'require tp=1.')
 
 
 def _add_validate(sub):
@@ -522,6 +530,16 @@ def _dispatch(args) -> int:
         ccs_calibration_values=calibration_lib.parse_calibration_string(
             args.ccs_calibration),
     )
+    mesh = None
+    if args.dp or args.tp > 1:
+      import jax
+
+      from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+      dp = args.dp or 1
+      mesh = mesh_lib.make_mesh(
+          dp=dp, tp=args.tp, devices=jax.devices()[:dp * args.tp]
+      )
     if args.random_init:
       import jax
       import jax.numpy as jnp
@@ -533,10 +551,11 @@ def _dispatch(args) -> int:
       variables = model_lib.get_model(params).init(
           jax.random.PRNGKey(0),
           jnp.zeros((1, params.total_rows, params.max_length, 1)))
-      runner = runner_lib.ModelRunner(params, variables, options)
+      runner = runner_lib.ModelRunner(params, variables, options,
+                                      mesh=mesh)
     elif args.checkpoint:
       runner = runner_lib.ModelRunner.from_checkpoint(
-          args.checkpoint, options)
+          args.checkpoint, options, mesh=mesh)
     else:
       raise ValueError('serve needs --checkpoint or --random_init')
     options.max_passes = runner.params.max_passes

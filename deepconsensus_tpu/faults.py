@@ -163,6 +163,23 @@ class NonFiniteTrainingError(RuntimeError):
   instead of looping on a diverged model."""
 
 
+class ExportedArtifactMismatchError(ValueError):
+  """An exported StableHLO artifact cannot serve the requested topology
+  (fixed-batch artifact under a --dp mesh, or any mesh with a model
+  axis > 1). Operator error at startup, not a data-plane fault: the
+  CLI maps it to exit code 2 like other config ValueErrors.
+
+  reexport_command, when the fix is a re-export, is appended to the
+  message so the operator can copy-paste the remedy."""
+
+  def __init__(self, message: str,
+               reexport_command: Optional[str] = None):
+    if reexport_command:
+      message = f'{message} (re-export with: {reexport_command})'
+    super().__init__(message)
+    self.reexport_command = reexport_command
+
+
 # ----------------------------------------------------------------------
 # Dead-letter sidecar (JSONL, one object per line)
 

@@ -332,12 +332,18 @@ class ConsensusEngine:
     return self._packer.model_wall
 
   def stats(self) -> Dict[str, Any]:
-    return {
+    out = {
         'n_model_packs': self.n_packs,
         'n_model_pack_rows': self.n_pack_rows,
         'n_model_pad_rows': self.n_pad_rows,
         'model_wall_s': round(self.model_wall, 3),
     }
+    # Sharded-dispatch / transfer-overlap counters (stub runners in
+    # tests may not implement the full dispatch contract).
+    dispatch_stats = getattr(self.runner, 'dispatch_stats', None)
+    if dispatch_stats is not None:
+      out.update(dispatch_stats())
+    return out
 
   def predict_windows(
       self, raw_windows: np.ndarray

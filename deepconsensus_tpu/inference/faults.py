@@ -59,6 +59,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     DeadLetterWriter,
     DeadlineExceededError,
     DrainingError,
+    ExportedArtifactMismatchError,
     FaultKind,
     NonFiniteTrainingError,
     RequestTooLargeError,

@@ -24,11 +24,18 @@ import json
 import logging
 import os
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# The forward donates its input buffers (see _jit_forward); backends
+# that can't reuse a given donated buffer (host CPU, notably) warn per
+# dispatch, which would flood batch runs.
+warnings.filterwarnings(
+    'ignore', message='Some donated buffers were not usable')
 
 from deepconsensus_tpu.calibration import lib as calibration_lib
 from deepconsensus_tpu.inference import engine as engine_lib
@@ -189,6 +196,32 @@ def _check_dp_divisible(options: 'InferenceOptions', mesh) -> int:
   return dp
 
 
+class _DispatchHandle:
+  """One in-flight pack: the runner's dispatch contract.
+
+  dispatch() returns one of these holding the pack's (dp-sharded)
+  device inputs in the transfer slot; the matching forward launches
+  either when the NEXT pack dispatches (so pack N+1's host->device
+  transfer overlaps pack N's compute) or on demand in
+  raw_outputs()/finalize(). A launch error is stored here and
+  re-raised at finalize time, so the engine's pack-failure routing
+  attributes it to the pack that actually failed, not the pack whose
+  dispatch happened to trigger the launch.
+  """
+
+  __slots__ = ('inputs', 'n', 'outputs', 'error')
+
+  def __init__(self, inputs, n: int):
+    self.inputs = inputs  # (main_u8_dev, sn_dev); cleared at launch
+    self.n = n
+    self.outputs = None  # (pred_ids_dev, max_prob_dev) once launched
+    self.error = None
+
+  @property
+  def launched(self) -> bool:
+    return self.outputs is not None or self.error is not None
+
+
 class ModelRunner:
   """Jitted forward pass producing (bases, quality scores) per window.
 
@@ -233,11 +266,34 @@ class ModelRunner:
       return pred_ids, max_prob
 
     self._forward = self._jit_forward(forward, mesh)
+    self._init_dispatch_state(mesh)
+
+  def _init_dispatch_state(self, mesh) -> None:
+    """Dispatch-contract state shared by __init__ and from_exported
+    (which builds the runner via cls.__new__)."""
+    if mesh is not None:
+      from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+      self._input_sharding = mesh_lib.batch_sharding(mesh)
+    else:
+      self._input_sharding = None
+    # dclint: lock-free (single transfer slot: the model-loop thread
+    # is the sole device owner — dispatch/finalize are never called
+    # concurrently, per the engine's single-thread contract)
+    self._pending: Optional[_DispatchHandle] = None
+    self._n_dispatched = 0
+    self._n_dispatched_sharded = 0
+    self._n_overlapped_launches = 0
+    self._n_direct_launches = 0
 
   @staticmethod
   def _jit_forward(forward, mesh):
+    # donate_argnums: the uint8 pack and SN buffers are dead after the
+    # forward (finalize only touches the outputs), so steady state
+    # reuses their device memory instead of growing the arena by one
+    # pack per in-flight dispatch.
     if mesh is None:
-      return jax.jit(forward)
+      return jax.jit(forward, donate_argnums=(1, 2))
     from deepconsensus_tpu.parallel import mesh as mesh_lib
 
     batch_sh = mesh_lib.batch_sharding(mesh)
@@ -247,6 +303,7 @@ class ModelRunner:
         # or model-axis sharded under tp>1).
         in_shardings=(None, batch_sh, batch_sh),
         out_shardings=(batch_sh, batch_sh),
+        donate_argnums=(1, 2),
     )
 
   @classmethod
@@ -295,12 +352,14 @@ class ModelRunner:
     if not meta.get('polymorphic_batch'):
       # Fixed-batch artifact: the compiled shape wins over the flag.
       if mesh is not None:
-        # dclint: allow=typed-faults (startup artifact/flag mismatch,
-        # an operator error — not a runtime data-plane fault)
-        raise ValueError(
+        raise faults.ExportedArtifactMismatchError(
             'mesh/--dp serving of an exported artifact requires a '
             'batch-polymorphic export (this artifact is fixed-batch; '
-            're-export with polymorphic_batch=True)'
+            're-export with polymorphic_batch=True)',
+            reexport_command=(
+                'dctpu export --checkpoint <orbax_ckpt> '
+                f'--output {export_dir} --strict_polymorphic'
+            ),
         )
       options.batch_size = int(meta['batch_size'])
     runner.options = options
@@ -317,7 +376,9 @@ class ModelRunner:
 
     if mesh is None:
       runner._forward = jax.jit(
-          lambda _variables, main_u8, sn: apply_serving(main_u8, sn))
+          lambda _variables, main_u8, sn: apply_serving(main_u8, sn),
+          donate_argnums=(1, 2))
+      runner._init_dispatch_state(mesh)
       return runner
 
     from jax.sharding import PartitionSpec
@@ -330,9 +391,7 @@ class ModelRunner:
 
     if mesh_lib.MODEL_AXIS in mesh.shape and (
         mesh.shape[mesh_lib.MODEL_AXIS] > 1):
-      # dclint: allow=typed-faults (startup artifact/flag mismatch,
-      # an operator error — not a runtime data-plane fault)
-      raise ValueError(
+      raise faults.ExportedArtifactMismatchError(
           'exported artifacts serve data-parallel only (the compiled '
           'program cannot be re-sharded on the model axis); use tp=1 '
           'or an orbax checkpoint'
@@ -343,16 +402,28 @@ class ModelRunner:
         apply_serving, mesh=mesh,
         in_specs=(batch_spec, batch_spec),
         out_specs=(batch_spec, batch_spec),
+        # The exported-call primitive has no replication-check rule;
+        # both specs are fully dp-sharded anyway, so there is nothing
+        # for the checker to prove.
+        check_rep=False,
     )
     runner._forward = jax.jit(
-        lambda _variables, main_u8, sn: sharded_serving(main_u8, sn))
+        lambda _variables, main_u8, sn: sharded_serving(main_u8, sn),
+        donate_argnums=(1, 2))
+    runner._init_dispatch_state(mesh)
     return runner
 
-  def dispatch(self, rows: np.ndarray):
-    """Async device dispatch: rows [B, R, L, 1] -> (dev_ids, dev_prob, n).
+  def dispatch(self, rows: np.ndarray) -> _DispatchHandle:
+    """Async sharded dispatch: rows [B, R, L, 1] -> _DispatchHandle.
 
-    Pads to the fixed compiled batch shape and returns device arrays
-    immediately so the next batch's host work overlaps device compute.
+    Pads to the fixed compiled batch shape, places the compact pack on
+    the device(s) with an async `jax.device_put` (dp-sharded over the
+    mesh data axis when a mesh is configured), and returns a handle
+    holding the in-flight transfer slot. The matching forward is
+    double-buffered: it launches when the NEXT pack dispatches — so
+    this pack's compute overlaps that pack's host->device transfer —
+    or on demand in finalize(). The forward donates the input buffers,
+    so steady state reuses device memory.
 
     Transfer is compact: every non-SN row holds clip-bounded integers
     (bases/ccs 0-4, pw/ip <= PW_MAX/IP_MAX = 255, strand 0-2, ccs_bq
@@ -374,14 +445,76 @@ class ModelRunner:
       main_u8[:, self._bq_row] = (main[:, self._bq_row] + 1.0).astype(
           np.uint8)
     sn = np.ascontiguousarray(rows[:, -_SN_ROWS:, 0, 0].astype(np.float32))
-    pred_ids, max_prob = self._forward(
-        self.variables, jnp.asarray(main_u8), jnp.asarray(sn)
-    )
-    return pred_ids, max_prob, n
+    # Launch the previous pack's forward BEFORE starting this pack's
+    # transfer, so the device_put below overlaps its compute.
+    self._launch_pending()
+    if self._input_sharding is not None:
+      main_dev = jax.device_put(main_u8, self._input_sharding)
+      sn_dev = jax.device_put(sn, self._input_sharding)
+      self._n_dispatched_sharded += 1
+    else:
+      main_dev = jax.device_put(main_u8)
+      sn_dev = jax.device_put(sn)
+    self._n_dispatched += 1
+    handle = _DispatchHandle((main_dev, sn_dev), n)
+    self._pending = handle
+    return handle
+
+  def _launch_pending(self) -> None:
+    """Launches the forward for the pack currently in the transfer
+    slot, if any (the overlapped half of the double buffer)."""
+    handle, self._pending = self._pending, None
+    if handle is None or handle.launched:
+      return
+    self._launch(handle)
+    self._n_overlapped_launches += 1
+
+  def _launch(self, handle: _DispatchHandle) -> None:
+    """Runs the jitted forward on a handle's device inputs. An error is
+    stored on the handle (re-raised by raw_outputs/finalize) so the
+    engine attributes it to the failing pack, not to whichever later
+    dispatch happened to trigger this launch."""
+    main_dev, sn_dev = handle.inputs
+    # Drop our references before the call: the jit donates these
+    # buffers, so they must not be reachable (or reused) afterwards.
+    handle.inputs = None
+    try:
+      handle.outputs = self._forward(self.variables, main_dev, sn_dev)
+    # dclint: allow=typed-faults (deferred-launch error capture: the
+    # original exception is re-raised verbatim at finalize time, where
+    # pack-failure routing can attribute it to the right tickets)
+    except Exception as e:
+      handle.error = e
+
+  def raw_outputs(self, dispatched: _DispatchHandle):
+    """Device arrays (pred_ids, max_prob, n) for a dispatch handle,
+    launching its forward now if no later dispatch overlapped it."""
+    handle = dispatched
+    if not handle.launched:
+      if self._pending is handle:
+        self._pending = None
+      self._launch(handle)
+      self._n_direct_launches += 1
+    if handle.error is not None:
+      raise handle.error
+    pred_ids, max_prob = handle.outputs
+    return pred_ids, max_prob, handle.n
+
+  def dispatch_stats(self) -> Dict[str, Any]:
+    """Transfer/overlap counters for /metricz and the bench stages."""
+    launches = self._n_overlapped_launches + self._n_direct_launches
+    return {
+        'n_packs_dispatched_sharded': self._n_dispatched_sharded,
+        'n_transfer_overlapped': self._n_overlapped_launches,
+        'n_transfer_direct': self._n_direct_launches,
+        'transfer_overlap_fraction': (
+            round(self._n_overlapped_launches / launches, 4)
+            if launches else 0.0),
+    }
 
   def finalize(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
     """Resolves a dispatch into (base ids [n, L], quality [n, L])."""
-    pred_ids, max_prob, n = dispatched
+    pred_ids, max_prob, n = self.raw_outputs(dispatched)
     # Slice on the host: indexing the device array with a varying [:n]
     # would lower (and cache) a fresh jitted slice per tail size.
     # dclint: allow=jit-hazards (finalize IS the sync point: results
@@ -1374,6 +1507,10 @@ def run_inference(
           window_counter['n_model_packs'] = engine.n_packs
           window_counter['n_model_pack_rows'] = engine.n_pack_rows
           window_counter['n_model_pad_rows'] = engine.n_pad_rows
+          dispatch_stats = getattr(runner, 'dispatch_stats', None)
+          if dispatch_stats is not None:
+            for key, value in dispatch_stats().items():
+              window_counter[key] = value
         if thread.is_alive():
           # Draining now would race the producer's put(); anything it
           # enqueues after our drain would leak its shm segments.

@@ -18,7 +18,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:
+  from jax import shard_map  # jax >= 0.8
+except ImportError:
+  from jax.experimental.shard_map import shard_map
 
 Array = jnp.ndarray
 

@@ -544,6 +544,13 @@ class ConsensusService:
     counters.setdefault('n_rejected_backpressure', 0)
     counters.setdefault('n_deadline_cancelled', 0)
     counters.setdefault('n_quarantined_by_request', 0)
+    # Sharded-dispatch / transfer-overlap counters live in the faults
+    # split; the zero defaults keep the keys present under stub
+    # runners that don't implement the full dispatch contract.
+    counters.setdefault('n_packs_dispatched_sharded', 0)
+    counters.setdefault('n_transfer_overlapped', 0)
+    counters.setdefault('n_transfer_direct', 0)
+    counters.setdefault('transfer_overlap_fraction', 0.0)
     with self._lock:
       outstanding = len(self._outstanding)
     out = {
@@ -554,5 +561,9 @@ class ConsensusService:
         'latency': self.latency_percentiles(),
         'outcomes': dataclasses.asdict(self.outcome),
     }
-    out.update(self.engine.stats())
+    engine_stats = self.engine.stats()
+    for key in tuple(engine_stats):
+      if key in counters:
+        counters[key] = engine_stats.pop(key)
+    out.update(engine_stats)
     return out

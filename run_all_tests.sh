@@ -11,6 +11,12 @@
 #   ./run_all_tests.sh serve       # `dctpu serve` stage only (engine
 #                                  # boundary, service fault drills,
 #                                  # SIGTERM-under-load drain)
+#   ./run_all_tests.sh multichip   # dp-sharded dispatch tests only,
+#                                  # over the 8 forced host-platform
+#                                  # devices (conftest.py sets
+#                                  # --xla_force_host_platform_device_count=8,
+#                                  # so the default and fast tiers run
+#                                  # these too)
 #
 # Two-tier structure: the `slow` marker covers the heavy interpret-mode
 # Pallas golden sweeps (wavefront train/VJP/unroll, banded-attention
@@ -47,6 +53,10 @@ fi
 
 if [[ "${1:-}" == "serve" ]]; then
   exec scripts/run_resilience.sh --serve
+fi
+
+if [[ "${1:-}" == "multichip" ]]; then
+  exec python -m pytest tests/ -q -m multichip
 fi
 
 # Static analysis first: dclint runs in under a second and fails fast

@@ -66,6 +66,16 @@ run_stage forward_fused_tile16 600 \
   env DC_TPU_FUSED_TILE=16 \
   python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
   --set use_fused_hotpath=true
+# dp-sharded double-buffered dispatch (round-6 tentpole): real-chip dp
+# scaling of windows/s + transfer-overlap fraction. Staged to fire on
+# first live tunnel; until then the host-platform parity sweep lives
+# in MULTICHIP_r06.json (bench.py dp_scaling stage). Read against
+# forward_profile's b1024 line: dp>1 only earns its keep if windows/s
+# scales while the overlap fraction stays near (packs-1)/packs.
+run_stage forward_dp2 600 \
+  python "$REPO/scripts/bench_dp_scaling.py" --dp 2 --batch 1024 --packs 8
+run_stage forward_dp4 600 \
+  python "$REPO/scripts/bench_dp_scaling.py" --dp 4 --batch 1024 --packs 8
 run_stage e2e_depth8 1200 \
   python "$REPO/scripts/bench_e2e.py" --repeats 6 --depth 8
 run_stage e2e_depth1 600 \

@@ -36,6 +36,11 @@ def pytest_configure(config):
       'resilience: fault-injection tests for the inference and '
       'training fault-tolerance layers (scripts/run_resilience.sh)',
   )
+  config.addinivalue_line(
+      'markers',
+      'multichip: data-parallel sharded-dispatch tests driven over '
+      'the 8 forced host-platform devices (run_all_tests.sh multichip)',
+  )
 
 
 @pytest.fixture(scope='session')

@@ -236,6 +236,63 @@ class TestJitHazards:
         """)
     assert found == []
 
+  def test_catches_double_buffer_sync_before_forward(self):
+    """A device_put transfer host-materialised before the forward
+    consumes it defeats the transfer/compute overlap."""
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+        import numpy as np
+
+        class R:
+          def dispatch(self, rows):
+            main_dev = jax.device_put(rows, self._sharding)
+            peek = np.asarray(main_dev)
+            out = self._forward(self.variables, main_dev)
+            return out
+        """)
+    assert any('double-buffer hazard' in f.message for f in found)
+
+  def test_catches_double_buffer_sync_with_no_forward(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+
+        class R:
+          def dispatch(self, rows):
+            main_dev = jax.device_put(rows, self._sharding)
+            return float(main_dev[0, 0])
+        """)
+    assert any('double-buffer hazard' in f.message for f in found)
+
+  def test_passes_double_buffer_transfer_into_forward(self):
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+
+        class R:
+          def dispatch(self, rows):
+            main_dev = jax.device_put(rows, self._sharding)
+            out = self._forward(self.variables, main_dev)
+            return out
+        """)
+    assert found == []
+
+  def test_passes_sync_after_forward_consumed_transfer(self):
+    """Materialising the transfer AFTER the forward consumed it is not
+    a double-buffer hazard (the generic host-sync rule still governs
+    it; here the allow comment covers that deliberate sync)."""
+    found = findings_for(jit_hazards, self.RUNNER, """\
+        import jax
+        import numpy as np
+
+        class R:
+          def dispatch(self, rows):
+            main_dev = jax.device_put(rows, self._sharding)
+            out = self._forward(self.variables, main_dev)
+            # dclint: allow=jit-hazards (post-forward debug readback)
+            dbg = np.asarray(main_dev)
+            return out
+        """)
+    assert found == []
+
 
 # ---------------------------------------------------------------------------
 # guarded-by

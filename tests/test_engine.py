@@ -295,3 +295,122 @@ def test_engine_reproduces_batch_pipeline_bytes(tmp_path, synthetic_bams,
     if result is not None:
       direct += stitch.format_fastq_bytes(name, *result)
   assert direct == pipeline_bytes
+
+
+# ----------------------------------------------------------------------
+# Data-parallel sharded dispatch (8 forced host-platform devices)
+
+
+def _real_runner(params, mesh=None, batch=BATCH):
+  variables = model_lib.get_model(params).init(
+      jax.random.PRNGKey(0),
+      jnp.zeros((1, params.total_rows, params.max_length, 1)))
+  options = runner_lib.InferenceOptions(batch_size=batch)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  return runner_lib.ModelRunner(params, variables, options,
+                                mesh=mesh), options
+
+
+@pytest.mark.multichip
+def test_engine_byte_identity_single_vs_dp8(params):
+  """The engine boundary must produce identical uint8 (ids, quals)
+  whether the runner dispatches to one device or dp-shards each pack
+  over all 8 — full packs and the padded flush tail alike."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  raw = _raw_windows(params, 21, seed=11)  # 2 full packs + ragged tail
+  runner_s, options_s = _real_runner(params)
+  runner_m, options_m = _real_runner(params, mesh=mesh)
+  engine_s = engine_lib.ConsensusEngine(
+      runner_s, options_s, deliver=lambda t, ids, quals: None)
+  engine_m = engine_lib.ConsensusEngine(
+      runner_m, options_m, deliver=lambda t, ids, quals: None)
+  ids_s, quals_s = engine_s.predict_windows(raw)
+  ids_m, quals_m = engine_m.predict_windows(raw)
+  np.testing.assert_array_equal(ids_s, ids_m)
+  np.testing.assert_array_equal(quals_s, quals_m)
+  stats = engine_m.stats()
+  assert stats['n_packs_dispatched_sharded'] == 3
+  assert engine_s.stats()['n_packs_dispatched_sharded'] == 0
+
+
+@pytest.mark.multichip
+def test_dispatch_handles_are_dp_sharded(params):
+  """The dispatch contract: the transfer slot holds dp-sharded input
+  buffers, the forward launches when the next pack dispatches
+  (overlapped) or at finalize (direct), and the logits come back
+  sharded on the data axis."""
+  from deepconsensus_tpu.models import data as data_lib
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  batch_sh = mesh_lib.batch_sharding(mesh)
+  runner, _ = _real_runner(params, mesh=mesh)
+  rows1 = data_lib.format_rows_batch(_raw_windows(params, BATCH, 1), params)
+  rows2 = data_lib.format_rows_batch(_raw_windows(params, BATCH, 2), params)
+  h1 = runner.dispatch(rows1)
+  # Pack 1 sits in the transfer slot: inputs placed, forward not run.
+  assert not h1.launched
+  assert h1.inputs[0].sharding == batch_sh
+  assert h1.inputs[1].sharding == batch_sh
+  h2 = runner.dispatch(rows2)
+  # Pack 2's dispatch launched pack 1's forward (overlapped); its own
+  # transfer slot is sharded and still pending.
+  assert h1.launched and h1.outputs is not None
+  assert h1.outputs[0].sharding.is_equivalent_to(
+      batch_sh, h1.outputs[0].ndim)
+  assert not h2.launched
+  assert h2.inputs[0].sharding == batch_sh
+  ids1, quals1 = runner.finalize(h1)
+  ids2, quals2 = runner.finalize(h2)  # direct launch: nothing followed
+  assert ids1.shape == ids2.shape == (BATCH, params.max_length)
+  stats = runner.dispatch_stats()
+  assert stats['n_packs_dispatched_sharded'] == 2
+  assert stats['n_transfer_overlapped'] == 1
+  assert stats['n_transfer_direct'] == 1
+  assert stats['transfer_overlap_fraction'] == 0.5
+
+
+@pytest.mark.multichip
+def test_deferred_launch_failure_attributed_to_failing_pack(params):
+  """Double-buffering defers pack N's forward launch into pack N+1's
+  dispatch; a launch error must still surface at pack N's finalize so
+  the engine quarantines pack N's tickets — and the packs around it
+  deliver, in featurize order."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  runner, options = _real_runner(params, mesh=mesh)
+  real_forward = runner._forward
+  calls = [0]
+
+  def flaky_forward(variables, main_u8, sn):
+    calls[0] += 1
+    if calls[0] == 2:
+      raise RuntimeError('injected mid-stream forward failure')
+    return real_forward(variables, main_u8, sn)
+
+  runner._forward = flaky_forward
+  delivered = {}
+  failures = []
+  engine = engine_lib.ConsensusEngine(
+      runner, options,
+      deliver=lambda t, ids, quals: delivered.__setitem__(t, ids),
+      on_pack_failure=lambda ts, seq, e: failures.append(
+          (list(ts), seq, str(e))))
+  engine.submit(_raw_windows(params, 3 * BATCH, seed=13),
+                list(range(3 * BATCH)))
+  engine.flush()
+  # The error was raised while pack 2 dispatched, but it belongs to
+  # pack 1: exactly pack 1's tickets fail, with its pack seq.
+  assert len(failures) == 1
+  failed_tickets, seq, err = failures[0]
+  assert seq == 1
+  assert failed_tickets == list(range(BATCH, 2 * BATCH))
+  assert 'injected mid-stream forward failure' in err
+  # Packs 0 and 2 delivered, in featurize order.
+  assert list(delivered) == (
+      list(range(BATCH)) + list(range(2 * BATCH, 3 * BATCH)))

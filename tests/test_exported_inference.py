@@ -111,12 +111,40 @@ def test_exported_serves_on_dp_mesh(tmp_path):
 def test_fixed_export_rejects_mesh(tmp_path):
   import pytest
 
+  from deepconsensus_tpu import faults as faults_lib
   from deepconsensus_tpu.parallel import mesh as mesh_lib
 
   if len(jax.devices()) < 2:
     pytest.skip('needs multiple devices')
   _, _, _, export_dir = tiny_export(tmp_path, polymorphic=False)
   mesh = mesh_lib.make_mesh(tp=1, devices=jax.devices()[:2])
-  with pytest.raises(ValueError, match='batch-polymorphic'):
+  with pytest.raises(ValueError, match='batch-polymorphic') as excinfo:
+    runner_lib.ModelRunner.from_exported(
+        export_dir, runner_lib.InferenceOptions(batch_size=64), mesh=mesh)
+  # Typed fault naming the exact re-export command, not a bare
+  # ValueError (the CLI still maps it to exit code 2).
+  err = excinfo.value
+  assert isinstance(err, faults_lib.ExportedArtifactMismatchError)
+  assert err.reexport_command is not None
+  assert 'dctpu export' in err.reexport_command
+  assert '--strict_polymorphic' in err.reexport_command
+  assert err.reexport_command in str(err)
+
+
+def test_exported_model_axis_mesh_rejected(tmp_path):
+  """tp>1 over an exported artifact is a topology the baked program
+  cannot serve; the rejection is the same typed fault (no re-export
+  command: re-exporting would not help tp)."""
+  import pytest
+
+  from deepconsensus_tpu import faults as faults_lib
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  if len(jax.devices()) < 2:
+    pytest.skip('needs multiple devices')
+  _, _, _, export_dir = tiny_export(tmp_path)
+  mesh = mesh_lib.make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+  with pytest.raises(faults_lib.ExportedArtifactMismatchError,
+                     match='model axis'):
     runner_lib.ModelRunner.from_exported(
         export_dir, runner_lib.InferenceOptions(batch_size=64), mesh=mesh)

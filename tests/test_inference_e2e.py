@@ -126,7 +126,7 @@ def test_compact_dispatch_lossless_with_ccs_bq():
   options = runner_lib.InferenceOptions(batch_size=batch)
   runner = runner_lib.ModelRunner(params, variables, options)
 
-  pred_ids, max_prob, n = runner.dispatch(rows)
+  pred_ids, max_prob, n = runner.raw_outputs(runner.dispatch(rows))
   direct = model.apply(variables, jnp.asarray(rows))
   np.testing.assert_array_equal(
       np.asarray(pred_ids[:n]), np.asarray(jnp.argmax(direct, axis=-1)))

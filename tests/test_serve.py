@@ -415,6 +415,69 @@ def test_client_sabotage_env_hooks(serve_ctx, params, monkeypatch):
 
 
 # ----------------------------------------------------------------------
+# Data-parallel serving: mesh-backed service vs single-device service
+
+
+@pytest.mark.multichip
+def test_serve_with_mesh_byte_identical_to_single_device(params):
+  """A dp=8 mesh behind the service must be invisible to clients:
+  every response byte-matches the single-device service, while
+  /metricz's faults split reports the sharded-dispatch counters."""
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  variables = model_lib.get_model(params).init(
+      jax.random.PRNGKey(0),
+      jnp.zeros((1, params.total_rows, params.max_length, 1)))
+  mols = [_mol(params, f'm/{i}/ccs', n=3 + i % 4, seed=i)
+          for i in range(6)]
+
+  def serve_all(mesh):
+    options = runner_lib.InferenceOptions(
+        batch_size=BATCH, min_quality=0, min_length=0)
+    options.max_passes = params.max_passes
+    options.max_length = params.max_length
+    options.use_ccs_bq = params.use_ccs_bq
+    runner = runner_lib.ModelRunner(params, variables, options,
+                                    mesh=mesh)
+    service = ConsensusService(runner, options,
+                               ServeOptions(io_timeout_s=2.0))
+    service.warmup()
+    service.start()
+    httpd = server_lib.build_server(service, '127.0.0.1', 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+      client = ServeClient(port=httpd.server_address[1], timeout=30)
+      assert client.wait_ready(10)
+      responses = [client.polish(**m) for m in mols]
+      metrics = client.metricz()
+    finally:
+      service.begin_drain()
+      httpd.shutdown()
+      httpd.server_close()
+      service.drain(timeout=10)
+    return responses, metrics
+
+  single, metrics_single = serve_all(None)
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  sharded, metrics_sharded = serve_all(mesh)
+
+  for i, (s, m) in enumerate(zip(single, sharded)):
+    assert m['status'] == s['status'], i
+    assert m['seq'] == s['seq'], i
+    np.testing.assert_array_equal(m['quals'], s['quals'])
+  assert metrics_single['faults']['n_packs_dispatched_sharded'] == 0
+  faults = metrics_sharded['faults']
+  assert faults['n_packs_dispatched_sharded'] > 0
+  assert (faults['n_transfer_overlapped']
+          + faults['n_transfer_direct']) >= faults[
+              'n_packs_dispatched_sharded']
+
+
+# ----------------------------------------------------------------------
 # Subprocess acceptance demo: SIGTERM drain under load, clean exit
 
 

@@ -44,6 +44,7 @@ FAULT_TYPES = frozenset({
     'RequestTooLargeError',
     'CrashLoopError',
     'NonFiniteTrainingError',
+    'ExportedArtifactMismatchError',
     # deepconsensus_tpu/inference/faults.py
     'ZmwFault',
     'WatchdogTimeout',
@@ -98,7 +99,8 @@ HOT_FUNCTIONS = {
         'submit', 'submit_formatted',
     }),
     'deepconsensus_tpu/inference/runner.py': frozenset({
-        'dispatch', 'finalize', 'predict',
+        'dispatch', 'finalize', 'predict', '_launch', '_launch_pending',
+        'raw_outputs',
     }),
     'deepconsensus_tpu/serve/service.py': frozenset({
         '_model_loop', '_ingest', '_deliver', '_process_retries',
@@ -114,14 +116,25 @@ DEVICE_SOURCE_CALLS = frozenset({
 })
 
 # Function parameters known to carry device values (the engine hands
-# `ModelRunner.dispatch` results straight to `finalize`).
+# `ModelRunner.dispatch` results straight to `finalize` /
+# `raw_outputs`, and `_launch` receives the in-flight handle).
 DEVICE_PARAMS = {
     ('deepconsensus_tpu/inference/runner.py', 'finalize'): frozenset(
         {'dispatched'}),
+    ('deepconsensus_tpu/inference/runner.py', 'raw_outputs'): frozenset(
+        {'dispatched'}),
+    ('deepconsensus_tpu/inference/runner.py', '_launch'): frozenset(
+        {'handle'}),
 }
 
 # Host-materialising calls: flagged when applied to a device value.
 HOST_SYNC_CALLS = frozenset({'float', 'int', 'bool', 'asarray', 'array'})
+
+# The jitted forward call (last dotted segment) that consumes a
+# double-buffered `device_put` transfer.  A host-materialising use of a
+# transfer result BEFORE this call is an implicit sync that defeats the
+# transfer/compute overlap (jit-hazards double-buffer rule).
+FORWARD_CALLS = frozenset({'_forward'})
 
 # ---------------------------------------------------------------------------
 # guarded-by

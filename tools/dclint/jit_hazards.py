@@ -8,7 +8,11 @@ hot functions), flag:
 * Python-scalar / ``len(...)`` positional args at jitted call sites
   (every new value retriggers compilation);
 * implicit device->host syncs: ``.item()``, ``float()/int()/bool()``
-  on device values, ``np.asarray``/``np.array`` of jit outputs.
+  on device values, ``np.asarray``/``np.array`` of jit outputs;
+* double-buffer hazards: a ``device_put`` transfer that is host-
+  materialised before the jitted forward consumes it — the implicit
+  sync serialises the transfer/compute overlap the double-buffered
+  dispatch path exists to create.
 
 A deliberate sync (there is exactly one, in ``ModelRunner.finalize``)
 carries ``# dclint: allow=jit-hazards (reason)``.
@@ -190,6 +194,72 @@ def _host_sync_findings(src: core.SourceFile, hot: Set[str],
   return out
 
 
+def _double_buffer_findings(src: core.SourceFile,
+                            hot: Set[str]) -> List[core.Finding]:
+  """Double-buffer idiom: a `device_put` result must reach the jitted
+  forward (config.FORWARD_CALLS) before anything host-materialises it.
+  Consuming the transfer on the host first blocks on the copy — an
+  implicit sync that serialises exactly the transfer/compute overlap
+  the double buffer exists to create."""
+  out = []
+  for fn in ast.walk(src.tree):
+    if not isinstance(fn, ast.FunctionDef) or fn.name not in hot:
+      continue
+    # Names bound to device_put(...) results inside this function.
+    transfers: Set[str] = set()
+    for node in ast.walk(fn):
+      if (isinstance(node, ast.Assign)
+          and isinstance(node.value, ast.Call)
+          and core.last_segment(node.value.func) == 'device_put'):
+        for tgt in node.targets:
+          seg = core.last_segment(tgt)
+          if seg:
+            transfers.add(seg)
+    if not transfers:
+      continue
+    # Earliest line where each transfer feeds the forward.
+    forward_line = {}
+    for node in ast.walk(fn):
+      if not (isinstance(node, ast.Call)
+              and core.last_segment(node.func) in config.FORWARD_CALLS):
+        continue
+      for arg in node.args:
+        for n in ast.walk(arg):
+          if isinstance(n, ast.Name) and n.id in transfers:
+            prev = forward_line.get(n.id)
+            if prev is None or node.lineno < prev:
+              forward_line[n.id] = node.lineno
+    for node in ast.walk(fn):
+      if not isinstance(node, ast.Call):
+        continue
+      if (isinstance(node.func, ast.Attribute)
+          and node.func.attr == 'item' and not node.args):
+        sync_target = node.func.value
+      elif (core.last_segment(node.func) in config.HOST_SYNC_CALLS
+            and node.args):
+        sync_target = node.args[0]
+      else:
+        continue
+      for n in ast.walk(sync_target):
+        if not (isinstance(n, ast.Name) and n.id in transfers):
+          continue
+        consumed_by_forward = forward_line.get(n.id)
+        if (consumed_by_forward is not None
+            and node.lineno > consumed_by_forward):
+          continue
+        if not src.allowed(RULE, node.lineno):
+          out.append(core.Finding(
+              RULE, src.path, node.lineno,
+              f'double-buffer hazard: `{n.id}` (a device_put transfer) '
+              f'is host-materialised in `{fn.name}` before the jitted '
+              'forward consumes it — the implicit sync serialises the '
+              'transfer/compute overlap; hand it to the forward first '
+              'or sync deliberately with '
+              '`# dclint: allow=jit-hazards (reason)`'))
+        break
+  return out
+
+
 def check(src: core.SourceFile) -> List[core.Finding]:
   if not core.in_scope(src.path, config.JIT_SCOPE):
     return []
@@ -198,4 +268,5 @@ def check(src: core.SourceFile) -> List[core.Finding]:
   handles = _jit_handles(src.tree)
   return (_construction_findings(src, hot)
           + _scalar_arg_findings(src, handles)
-          + _host_sync_findings(src, hot, handles))
+          + _host_sync_findings(src, hot, handles)
+          + _double_buffer_findings(src, hot))
