@@ -145,6 +145,24 @@ def _add_run(sub):
                  'a record claiming more than this many bytes is '
                  'treated as corrupt (quarantined under '
                  '--on_zmw_error=skip) instead of allocated.')
+  _add_device_fault_flags(p)
+
+
+def _add_device_fault_flags(p):
+  p.add_argument('--on_device_error', default='fail',
+                 choices=['fail', 'degrade'],
+                 help='Device fault policy: fail propagates device '
+                 'runtime errors (historical behavior); degrade '
+                 'bisects RESOURCE_EXHAUSTED packs to half batch and '
+                 'rebuilds the mesh one dp step down (8->4->2->1) '
+                 'after a lost/halted device, resubmitting the failed '
+                 'pack in featurize order.')
+  p.add_argument('--dispatch_timeout', type=float, default=0.0,
+                 help='Dispatch watchdog: bound each pack\'s blocking '
+                 'finalize to this many seconds; a hung forward '
+                 'surfaces as DispatchTimeoutError through pack '
+                 'failure attribution instead of wedging the model '
+                 'loop (0 disables).')
 
 
 def _add_serve(sub):
@@ -208,6 +226,7 @@ def _add_serve(sub):
                  help='Tensor-parallel devices per replica (model-axis '
                  'sharded attention/FFN weights); exported artifacts '
                  'require tp=1.')
+  _add_device_fault_flags(p)
 
 
 def _add_validate(sub):
@@ -525,6 +544,8 @@ def _dispatch(args) -> int:
         min_quality=args.min_quality,
         skip_windows_above=args.skip_windows_above,
         max_base_quality=args.max_base_quality,
+        on_device_error=args.on_device_error,
+        dispatch_timeout=args.dispatch_timeout,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal or 'skip'),
         ccs_calibration_values=calibration_lib.parse_calibration_string(
@@ -608,6 +629,8 @@ def _dispatch(args) -> int:
         resume=args.resume,
         dispatch_depth=args.dispatch_depth,
         emit_queue_depth=args.emit_queue_depth,
+        on_device_error=args.on_device_error,
+        dispatch_timeout=args.dispatch_timeout,
         pack_across_batches=not args.no_cross_batch_packing,
         max_record_bytes=args.max_record_bytes,
         dc_calibration_values=calibration_lib.parse_calibration_string(

@@ -180,6 +180,83 @@ class ExportedArtifactMismatchError(ValueError):
     self.reexport_command = reexport_command
 
 
+class DeviceFault(RuntimeError):
+  """Base for device-runtime failures surfaced at pack launch/finalize.
+
+  The sharded dispatch path wraps `XlaRuntimeError`s (and anything else
+  the jitted forward throws) into this family via
+  classify_device_error(), so the engine's fault policy can pattern
+  match on *types* instead of scraping runtime message strings. `kind`
+  feeds the shared transient/permanent classification; subclasses embed
+  the right marker by construction.
+  """
+
+  @property
+  def kind(self) -> str:
+    return classify_error(str(self))
+
+
+class DeviceOomError(DeviceFault):
+  """Device memory exhausted while launching/running a pack
+  (RESOURCE_EXHAUSTED). Transient by construction: the same windows
+  succeed at a smaller batch, so `--on_device_error=degrade` bisects
+  the pack instead of failing it."""
+
+  def __init__(self, detail: str):
+    if 'RESOURCE_EXHAUSTED' not in detail:
+      detail = f'RESOURCE_EXHAUSTED: {detail}'
+    super().__init__(detail)
+
+
+class DeviceLostError(DeviceFault):
+  """A device in the mesh halted or lost state (DATA_LOSS / INTERNAL /
+  halted). Permanent for the *current* mesh: no transient markers, so
+  retry-at-same-shape loops re-raise; `--on_device_error=degrade`
+  rebuilds the mesh at lower dp instead."""
+
+
+class DispatchTimeoutError(DeviceFault):
+  """The dispatch watchdog gave up waiting for a pack's finalize
+  (`--dispatch_timeout`). Transient by construction ('watchdog'
+  marker): the device may recover, but this pack's tickets are
+  attributed and failed so the model loop never wedges."""
+
+  def __init__(self, detail: str):
+    if 'watchdog' not in detail:
+      detail = f'{detail} (dispatch watchdog)'
+    super().__init__(detail)
+
+
+# Message signatures of a halted/lost device, as surfaced by the XLA
+# CPU/TPU runtimes.
+_DEVICE_LOST_MARKERS = (
+    'DATA_LOSS', 'INTERNAL:', 'halted', 'DEVICE_LOST', 'device is lost',
+)
+
+
+def classify_device_error(error: BaseException) -> BaseException:
+  """Wraps a device-runtime error into the typed DeviceFault family.
+
+  DeviceFaults pass through untouched. RESOURCE_EXHAUSTED becomes
+  DeviceOomError; halted/lost-device signatures become DeviceLostError;
+  anything else returns unchanged (host-side bugs keep their type so
+  per-ZMW attribution still sees e.g. the original ValueError). The
+  original error is chained via __cause__ for forensics.
+  """
+  if isinstance(error, DeviceFault):
+    return error
+  text = f'{type(error).__name__}: {error}'
+  wrapped: Optional[DeviceFault] = None
+  if 'RESOURCE_EXHAUSTED' in text:
+    wrapped = DeviceOomError(text)
+  elif any(marker in text for marker in _DEVICE_LOST_MARKERS):
+    wrapped = DeviceLostError(text)
+  if wrapped is None:
+    return error
+  wrapped.__cause__ = error
+  return wrapped
+
+
 # ----------------------------------------------------------------------
 # Dead-letter sidecar (JSONL, one object per line)
 
@@ -269,6 +346,17 @@ ENV_KILL_SHARD_READER = 'DCTPU_FAULT_KILL_SHARD_READER'
 ENV_POISON_WINDOW = 'DCTPU_FAULT_POISON_WINDOW'
 ENV_SERVE_CLIENT_FAULT = 'DCTPU_FAULT_SERVE_CLIENT'
 ENV_SERVE_CLIENT_FAULT_ZMW = 'DCTPU_FAULT_SERVE_CLIENT_ZMW'
+# Device-fault hooks (`inject_faults.py device`). Each targets a
+# 1-based pack ordinal in dispatch order and fires once per process:
+# OOM_AT_PACK raises RESOURCE_EXHAUSTED inside the pack's launch (the
+# degrade policy bisects it), LOST_AT_PACK raises a halted-device error
+# (the degrade policy drops to the next lower dp), HANG_AT_PACK makes
+# the pack's finalize sleep ENV_DEVICE_HANG_S seconds (default 30) so
+# the dispatch watchdog must fire.
+ENV_DEVICE_OOM_AT_PACK = 'DCTPU_FAULT_DEVICE_OOM_AT_PACK'
+ENV_DEVICE_LOST_AT_PACK = 'DCTPU_FAULT_DEVICE_LOST_AT_PACK'
+ENV_DEVICE_HANG_AT_PACK = 'DCTPU_FAULT_DEVICE_HANG_AT_PACK'
+ENV_DEVICE_HANG_S = 'DCTPU_FAULT_DEVICE_HANG_S'
 
 # Hooks that already fired in this process (consume-once semantics:
 # after a NaN-sentinel rollback the training loop passes the same step
@@ -361,6 +449,36 @@ def maybe_kill_train_at_step(step: int) -> None:
   import signal
 
   os.kill(os.getpid(), signal.SIGKILL)
+
+
+def injected_device_fault(pack_ordinal: int) -> None:
+  """Raises a synthetic device fault when an injection hook targets
+  this pack (1-based dispatch ordinal; fires once per process). Called
+  from inside the pack launch so the error surfaces exactly where a
+  real XlaRuntimeError would."""
+  if _fire_once(ENV_DEVICE_OOM_AT_PACK, pack_ordinal):
+    log.warning('fault injection: device OOM at pack %d', pack_ordinal)
+    raise DeviceOomError(
+        f'injected device OOM at pack {pack_ordinal}')
+  if _fire_once(ENV_DEVICE_LOST_AT_PACK, pack_ordinal):
+    log.warning('fault injection: device lost at pack %d', pack_ordinal)
+    raise DeviceLostError(
+        f'injected halted device at pack {pack_ordinal}')
+
+
+def injected_device_hang(pack_ordinal: int) -> float:
+  """Seconds the targeted pack's finalize should hang (0.0 when this
+  pack is not targeted). Fires once per process; the watchdog converts
+  the hang into a DispatchTimeoutError."""
+  if not _fire_once(ENV_DEVICE_HANG_AT_PACK, pack_ordinal):
+    return 0.0
+  try:
+    hang_s = float(os.environ.get(ENV_DEVICE_HANG_S, '30.0'))
+  except ValueError:
+    hang_s = 30.0
+  log.warning('fault injection: device hang %.1fs at pack %d',
+              hang_s, pack_ordinal)
+  return hang_s
 
 
 def maybe_kill_shard_reader(shard_path: str) -> None:

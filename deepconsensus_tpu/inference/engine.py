@@ -119,7 +119,13 @@ class _WindowPacker:
 
   A pack that fails to dispatch or finalize is routed to
   on_pack_failure(tickets, pack_seq, error) — ticket bookkeeping plus
-  any quarantine policy live with the caller.
+  any quarantine policy live with the caller. Under
+  on_device_error=degrade, typed device faults are absorbed first:
+  RESOURCE_EXHAUSTED bisects the pack (retry at half batch), a
+  lost/halted device rebuilds the mesh one dp step down and resubmits
+  everything that was in flight, in featurize order. Degrade mode
+  retains each in-flight pack's host rows to make that resubmission
+  possible (up to dispatch_depth packs of extra host memory).
   """
 
   def __init__(self, runner, options, timing_rows: List[Dict[str, Any]],
@@ -127,6 +133,7 @@ class _WindowPacker:
     self._runner = runner
     self._batch = options.batch_size
     self._depth = max(1, options.dispatch_depth)
+    self._degrade = getattr(options, 'on_device_error', 'fail') == 'degrade'
     self._timing_rows = timing_rows
     self._on_pack_failure = on_pack_failure
     self._deliver = deliver
@@ -138,6 +145,9 @@ class _WindowPacker:
     self.n_packs = 0
     self.n_pack_rows = 0
     self.n_pad_rows = 0
+    self.n_oom_bisections = 0
+    self.n_device_faults = 0
+    self.n_dispatch_timeouts = 0
     self.model_wall = 0.0
 
   def add(self, rows: np.ndarray, tickets: Sequence[Ticket]) -> None:
@@ -187,20 +197,28 @@ class _WindowPacker:
               f'in pack {seq})')
       handle = self._runner.dispatch(pack)
     except Exception as e:
-      self._on_pack_failure(tickets, seq, e)
+      self._handle_pack_fault(pack if self._degrade else None,
+                              tickets, seq, e)
       return
-    self._in_flight.append((handle, tickets, seq))
+    # Degrade mode keeps the host rows so a device fault can bisect or
+    # resubmit the pack; fail mode drops them (steady-state memory).
+    self._in_flight.append(
+        (handle, tickets, seq, pack if self._degrade else None))
     while len(self._in_flight) > self._depth:
       self._drain_one()
 
   def _drain_one(self) -> None:
-    handle, tickets, seq = self._in_flight.popleft()
+    handle, tickets, seq, pack = self._in_flight.popleft()
     t0 = time.time()
     try:
       pred_ids, quality = self._runner.finalize(handle)
     except Exception as e:
-      self._on_pack_failure(tickets, seq, e)
+      self._handle_pack_fault(pack, tickets, seq, e)
       return
+    self._deliver_pack(tickets, pred_ids, quality, t0)
+
+  def _deliver_pack(self, tickets: List[Ticket], pred_ids: np.ndarray,
+                    quality: np.ndarray, t0: float) -> None:
     # uint8 transport into the stitch plane (values are 0..4 / 0..93).
     ids_u8 = pred_ids.astype(np.uint8)
     quals_u8 = quality.astype(np.uint8)
@@ -211,6 +229,84 @@ class _WindowPacker:
     self._timing_rows.append(dict(
         stage='run_model', runtime=elapsed, n_zmws=0,
         n_examples=len(tickets), n_subreads=0))
+
+  def _handle_pack_fault(self, pack: Optional[np.ndarray],
+                         tickets: List[Ticket], seq: int,
+                         error: BaseException,
+                         batch_size: Optional[int] = None) -> None:
+    """Device-fault policy for one failed pack.
+
+    Classifies the error into the DeviceFault family; under
+    on_device_error=degrade (and with the pack's host rows retained)
+    OOM bisects and a lost device degrades the mesh. Anything
+    unrecovered routes to on_pack_failure with the classified error,
+    so dead-letters carry the device-fault kind.
+    """
+    error = faults_lib.classify_device_error(error)
+    if isinstance(error, faults_lib.DeviceFault):
+      self.n_device_faults += 1
+      if isinstance(error, faults_lib.DispatchTimeoutError):
+        # The watchdog already bounded the loss; retrying a hung
+        # device at the same (or any) shape would hang again.
+        self.n_dispatch_timeouts += 1
+      elif self._degrade and pack is not None:
+        if isinstance(error, faults_lib.DeviceOomError):
+          if self._bisect(pack, tickets, seq,
+                          batch_size or self._batch):
+            return
+        elif isinstance(error, faults_lib.DeviceLostError):
+          if self._try_degrade(pack, tickets, seq):
+            return
+    self._on_pack_failure(tickets, seq, error)
+
+  def _bisect(self, pack: np.ndarray, tickets: List[Ticket], seq: int,
+              batch_size: int) -> bool:
+    """OOM bisection: retry the pack as halves at half batch shape.
+
+    Floors at mesh-dp divisibility (the compiled batch must still
+    split over the data axis); returns False when no smaller shape
+    exists, handing the pack back to on_pack_failure.
+    """
+    dp = max(1, getattr(self._runner, 'mesh_dp', 0))
+    half = batch_size // 2
+    if half < 1 or half % dp:
+      return False
+    self.n_oom_bisections += 1
+    for lo in range(0, len(pack), half):
+      self._run_pack_at(pack[lo:lo + half], tickets[lo:lo + half],
+                        seq, half)
+    return True
+
+  def _try_degrade(self, pack: np.ndarray, tickets: List[Ticket],
+                   seq: int) -> bool:
+    """Mesh degradation: rebuild at the next lower dp and resubmit the
+    failed pack plus everything else in flight (launched on the dead
+    topology), in featurize (seq) order."""
+    degrade = getattr(self._runner, 'degrade_mesh', None)
+    if degrade is None or not degrade():
+      return False
+    pending = [(pack, tickets, seq)]
+    while self._in_flight:
+      _handle, ts, s, p = self._in_flight.popleft()
+      pending.append((p, ts, s))
+    for p, ts, s in sorted(pending, key=lambda entry: entry[2]):
+      self._run_pack_at(p, ts, s, self._batch)
+    return True
+
+  def _run_pack_at(self, pack: np.ndarray, tickets: List[Ticket],
+                   seq: int, batch_size: int) -> None:
+    """Synchronous retry of one (possibly bisected) pack at an explicit
+    batch shape. Further faults recurse through _handle_pack_fault, so
+    a bisected half can bisect again down to the dp floor."""
+    t0 = time.time()
+    try:
+      handle = self._runner.dispatch(pack, batch_size=batch_size)
+      pred_ids, quality = self._runner.finalize(handle)
+    except Exception as e:
+      self._handle_pack_fault(pack, tickets, seq, e,
+                              batch_size=batch_size)
+      return
+    self._deliver_pack(tickets, pred_ids, quality, t0)
 
   def flush(self, drain: bool = True) -> None:
     """Cuts the sub-batch tail as a final (padded) pack; with drain,
@@ -331,12 +427,27 @@ class ConsensusEngine:
   def model_wall(self) -> float:
     return self._packer.model_wall
 
+  @property
+  def n_oom_bisections(self) -> int:
+    return self._packer.n_oom_bisections
+
+  @property
+  def n_device_faults(self) -> int:
+    return self._packer.n_device_faults
+
+  @property
+  def n_dispatch_timeouts(self) -> int:
+    return self._packer.n_dispatch_timeouts
+
   def stats(self) -> Dict[str, Any]:
     out = {
         'n_model_packs': self.n_packs,
         'n_model_pack_rows': self.n_pack_rows,
         'n_model_pad_rows': self.n_pad_rows,
         'model_wall_s': round(self.model_wall, 3),
+        'n_oom_bisections': self.n_oom_bisections,
+        'n_device_faults': self.n_device_faults,
+        'n_dispatch_timeouts': self.n_dispatch_timeouts,
     }
     # Sharded-dispatch / transfer-overlap counters (stub runners in
     # tests may not implement the full dispatch contract).

@@ -42,6 +42,10 @@ import numpy as np
 # resolves here to the identical object).
 from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ENV_CRASH_AFTER_BATCHES,
+    ENV_DEVICE_HANG_AT_PACK,
+    ENV_DEVICE_HANG_S,
+    ENV_DEVICE_LOST_AT_PACK,
+    ENV_DEVICE_OOM_AT_PACK,
     ENV_KILL_SHARD_READER,
     ENV_KILL_TOKEN,
     ENV_KILL_TRAIN_AT_STEP,
@@ -58,14 +62,21 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     CrashLoopError,
     DeadLetterWriter,
     DeadlineExceededError,
+    DeviceFault,
+    DeviceLostError,
+    DeviceOomError,
+    DispatchTimeoutError,
     DrainingError,
     ExportedArtifactMismatchError,
     FaultKind,
     NonFiniteTrainingError,
     RequestTooLargeError,
     ServeRejection,
+    classify_device_error,
     classify_error,
     injected_crash_after_batches,
+    injected_device_fault,
+    injected_device_hang,
     maybe_kill_shard_reader,
     maybe_kill_train_at_step,
     maybe_kill_worker,

@@ -22,7 +22,9 @@ import dataclasses
 import itertools
 import json
 import logging
+import atexit
 import os
+import threading
 import time
 import warnings
 from typing import Any, Dict, List, Optional, Tuple
@@ -114,6 +116,18 @@ class InferenceOptions:
   # re-spawn + bounded retry (batch_retries) before quarantine.
   batch_timeout: float = 0.0
   batch_retries: int = 2
+  # Device fault domain (the sharded counterpart of on_zmw_error).
+  # 'fail' keeps bare propagation of device-runtime errors; 'degrade'
+  # turns RESOURCE_EXHAUSTED into pack bisection (retry at half batch,
+  # floored at dp divisibility) and repeated permanent device faults
+  # into mesh degradation (rebuild at the next lower dp, re-place
+  # weights, resubmit the failed pack in featurize order).
+  on_device_error: str = 'fail'  # fail | degrade
+  # >0: dispatch watchdog — bound the blocking finalize of each
+  # in-flight pack to this many seconds; a hung forward surfaces as a
+  # DispatchTimeoutError through pack-failure attribution instead of
+  # wedging the model loop.
+  dispatch_timeout: float = 0.0
   # Resume an interrupted run from <output>.progress.json + <output>.tmp.
   resume: bool = False
   # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
@@ -209,17 +223,82 @@ class _DispatchHandle:
   dispatch happened to trigger the launch.
   """
 
-  __slots__ = ('inputs', 'n', 'outputs', 'error')
+  __slots__ = ('inputs', 'n', 'outputs', 'error', 'seq', 'hang_s')
 
   def __init__(self, inputs, n: int):
     self.inputs = inputs  # (main_u8_dev, sn_dev); cleared at launch
     self.n = n
     self.outputs = None  # (pred_ids_dev, max_prob_dev) once launched
     self.error = None
+    self.seq = 0  # 1-based dispatch ordinal (fault-injection target)
+    self.hang_s = 0.0  # injected finalize hang (watchdog drills)
 
   @property
   def launched(self) -> bool:
     return self.outputs is not None or self.error is not None
+
+
+# Watchdog workers abandoned past their deadline. Joined (briefly) at
+# interpreter exit: a daemon thread still inside an XLA sync when
+# CPython tears down the runtime segfaults the process, so the exit
+# hook trades a bounded wait for a clean exit code. Slow-but-alive
+# packs finish inside the grace; a truly wedged device still exits
+# after it (and may then crash teardown — unavoidable without killing
+# the thread, which CPython cannot do safely).
+_abandoned_watchdogs: List[threading.Thread] = []
+_ABANDON_GRACE_S = 15.0
+
+
+def _join_abandoned_watchdogs() -> None:
+  deadline = time.monotonic() + _ABANDON_GRACE_S
+  for t in list(_abandoned_watchdogs):
+    t.join(max(0.0, deadline - time.monotonic()))
+
+
+atexit.register(_join_abandoned_watchdogs)
+
+
+def _finalize_with_watchdog(finalize_fn, dispatched, timeout: float):
+  """Bounds a blocking finalize: runs finalize_fn(dispatched) in a
+  worker thread and waits at most `timeout` seconds.
+
+  A device-side hang (wedged transfer, halted chip mid-collective)
+  otherwise blocks np.asarray forever and wedges the model loop; here
+  it surfaces as a DispatchTimeoutError that the engine's pack-failure
+  routing attributes to the hung pack's tickets. The worker is a
+  daemon: if the device never answers, the thread is abandoned with
+  its pack rather than keeping the process alive.
+
+  Module-level (not a ModelRunner method) on purpose: the runner's
+  dispatch state stays single-threaded — this helper owns the only
+  cross-thread hand-off, a single-producer result cell.
+  """
+  # dclint: lock-free (single-producer result cell: exactly one worker
+  # thread appends once; the waiter reads only after a successful join)
+  box = []
+
+  def worker():
+    try:
+      box.append(('ok', finalize_fn(dispatched)))
+    # dclint: allow=typed-faults (error capture for the cross-thread
+    # hand-off: the waiter re-raises it verbatim on the model loop)
+    except BaseException as e:
+      box.append(('error', e))
+
+  t = threading.Thread(
+      target=worker, name='dctpu-finalize-watchdog', daemon=True)
+  t.start()
+  t.join(timeout)
+  if t.is_alive() or not box:
+    if t.is_alive():
+      _abandoned_watchdogs.append(t)
+    raise faults.DispatchTimeoutError(
+        f'pack finalize produced no result within '
+        f'dispatch_timeout={timeout}s')
+  status, value = box[0]
+  if status == 'error':
+    raise value
+  return value
 
 
 class ModelRunner:
@@ -265,7 +344,10 @@ class ModelRunner:
       max_prob = jnp.max(preds, axis=-1)
       return pred_ids, max_prob
 
-    self._forward = self._jit_forward(forward, mesh)
+    # Retained so degrade_mesh() can recompile the same forward for a
+    # rebuilt (smaller) mesh.
+    self._make_forward = lambda m: self._jit_forward(forward, m)
+    self._forward = self._make_forward(mesh)
     self._init_dispatch_state(mesh)
 
   def _init_dispatch_state(self, mesh) -> None:
@@ -285,6 +367,15 @@ class ModelRunner:
     self._n_dispatched_sharded = 0
     self._n_overlapped_launches = 0
     self._n_direct_launches = 0
+    # Mesh-degradation ladder state: the dp we started with, and how
+    # many times degrade_mesh() stepped down.
+    if mesh is not None:
+      from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+      self._initial_dp = int(mesh.shape[mesh_lib.DATA_AXIS])
+    else:
+      self._initial_dp = 0
+    self._n_degraded = 0
 
   @staticmethod
   def _jit_forward(forward, mesh):
@@ -378,6 +469,9 @@ class ModelRunner:
       runner._forward = jax.jit(
           lambda _variables, main_u8, sn: apply_serving(main_u8, sn),
           donate_argnums=(1, 2))
+      # No mesh, no degradation ladder: degrade_mesh() bails before
+      # ever recompiling, so the identity rebuild is never called.
+      runner._make_forward = lambda _m: runner._forward
       runner._init_dispatch_state(mesh)
       return runner
 
@@ -398,22 +492,28 @@ class ModelRunner:
       )
     _check_dp_divisible(options, mesh)
     batch_spec = PartitionSpec(mesh_lib.DATA_AXIS)
-    sharded_serving = shard_map(
-        apply_serving, mesh=mesh,
-        in_specs=(batch_spec, batch_spec),
-        out_specs=(batch_spec, batch_spec),
-        # The exported-call primitive has no replication-check rule;
-        # both specs are fully dp-sharded anyway, so there is nothing
-        # for the checker to prove.
-        check_rep=False,
-    )
-    runner._forward = jax.jit(
-        lambda _variables, main_u8, sn: sharded_serving(main_u8, sn),
-        donate_argnums=(1, 2))
+
+    def make_forward(m):
+      sharded_serving = shard_map(
+          apply_serving, mesh=m,
+          in_specs=(batch_spec, batch_spec),
+          out_specs=(batch_spec, batch_spec),
+          # The exported-call primitive has no replication-check rule;
+          # both specs are fully dp-sharded anyway, so there is nothing
+          # for the checker to prove.
+          check_rep=False,
+      )
+      return jax.jit(
+          lambda _variables, main_u8, sn: sharded_serving(main_u8, sn),
+          donate_argnums=(1, 2))
+
+    runner._make_forward = make_forward
+    runner._forward = make_forward(mesh)
     runner._init_dispatch_state(mesh)
     return runner
 
-  def dispatch(self, rows: np.ndarray) -> _DispatchHandle:
+  def dispatch(self, rows: np.ndarray,
+               batch_size: Optional[int] = None) -> _DispatchHandle:
     """Async sharded dispatch: rows [B, R, L, 1] -> _DispatchHandle.
 
     Pads to the fixed compiled batch shape, places the compact pack on
@@ -431,9 +531,13 @@ class ModelRunner:
     constants, so the batch ships as uint8 rows + [B, 4] float SN
     scalars (~4x less than f32 rows over PCIe/tunnel) and reassembles
     losslessly on device (_assemble_rows undoes the ccs_bq bias).
+
+    batch_size overrides the compiled batch shape for this pack only
+    (OOM bisection retries at half batch; jit's per-shape cache keeps
+    one executable per distinct size).
     """
     n = rows.shape[0]
-    batch = self.options.batch_size
+    batch = batch_size or self.options.batch_size
     if n < batch:
       pad = np.zeros((batch - n,) + rows.shape[1:], rows.dtype)
       rows = np.concatenate([rows, pad])
@@ -457,6 +561,7 @@ class ModelRunner:
       sn_dev = jax.device_put(sn)
     self._n_dispatched += 1
     handle = _DispatchHandle((main_dev, sn_dev), n)
+    handle.seq = self._n_dispatched
     self._pending = handle
     return handle
 
@@ -479,12 +584,14 @@ class ModelRunner:
     # buffers, so they must not be reachable (or reused) afterwards.
     handle.inputs = None
     try:
+      faults.injected_device_fault(handle.seq)
+      handle.hang_s = faults.injected_device_hang(handle.seq)
       handle.outputs = self._forward(self.variables, main_dev, sn_dev)
     # dclint: allow=typed-faults (deferred-launch error capture: the
-    # original exception is re-raised verbatim at finalize time, where
+    # classified error is re-raised at finalize time, where
     # pack-failure routing can attribute it to the right tickets)
     except Exception as e:
-      handle.error = e
+      handle.error = faults.classify_device_error(e)
 
   def raw_outputs(self, dispatched: _DispatchHandle):
     """Device arrays (pred_ids, max_prob, n) for a dispatch handle,
@@ -510,11 +617,88 @@ class ModelRunner:
         'transfer_overlap_fraction': (
             round(self._n_overlapped_launches / launches, 4)
             if launches else 0.0),
+        'n_mesh_degradations': self._n_degraded,
+        'mesh_dp': self.mesh_dp,
     }
 
+  @property
+  def mesh_dp(self) -> int:
+    """Current data-axis width (0 without a mesh)."""
+    if self.mesh is None:
+      return 0
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    return int(self.mesh.shape[mesh_lib.DATA_AXIS])
+
+  @property
+  def is_degraded(self) -> bool:
+    """True once degrade_mesh() stepped below the launch topology."""
+    return self._n_degraded > 0
+
+  def degrade_mesh(self) -> Optional[int]:
+    """Rebuilds the mesh at the next lower dp (8 -> 4 -> 2 -> 1) after
+    a permanent device fault; returns the new dp, or None when no
+    smaller topology exists (single device, or no mesh at all).
+
+    Re-places the weights on the surviving devices and recompiles the
+    forward (jit caches per mesh, so a later un-degrade would be
+    cheap). The caller owns resubmission of whatever was in flight on
+    the old mesh; the stale transfer slot is abandoned here — its
+    buffers lived on the dead topology.
+    """
+    if self.mesh is None:
+      return None
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    dp = int(self.mesh.shape[mesh_lib.DATA_AXIS])
+    tp = int(self.mesh.shape.get(mesh_lib.MODEL_AXIS, 1))
+    new_dp = dp // 2
+    # The compiled batch must still split evenly over the data axis.
+    while new_dp >= 1 and self.options.batch_size % new_dp:
+      new_dp //= 2
+    if new_dp < 1 or new_dp >= dp:
+      return None
+    devices = np.asarray(self.mesh.devices).reshape(-1)[:new_dp * tp]
+    mesh = mesh_lib.make_mesh(dp=new_dp, tp=tp, devices=list(devices))
+    if self.variables:
+      self.variables = {
+          key: jax.device_put(
+              value,
+              mesh_lib.param_shardings(mesh, value)
+              if key == 'params' else mesh_lib.replicated(mesh),
+          )
+          for key, value in self.variables.items()
+      }
+    self.mesh = mesh
+    self._forward = self._make_forward(mesh)
+    self._input_sharding = mesh_lib.batch_sharding(mesh)
+    self._pending = None
+    self._n_degraded += 1
+    log.warning('mesh degraded to dp=%d (step %d of the ladder)',
+                new_dp, self._n_degraded)
+    return new_dp
+
   def finalize(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
-    """Resolves a dispatch into (base ids [n, L], quality [n, L])."""
+    """Resolves a dispatch into (base ids [n, L], quality [n, L]).
+
+    With --dispatch_timeout > 0 the blocking device sync is bounded by
+    the dispatch watchdog; a hang becomes DispatchTimeoutError.
+    """
+    timeout = self.options.dispatch_timeout
+    if timeout and timeout > 0:
+      return _finalize_with_watchdog(self._finalize_sync, dispatched,
+                                     timeout)
+    return self._finalize_sync(dispatched)
+
+  def _finalize_sync(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
+    """The blocking half of finalize: device sync + quality math."""
     pred_ids, max_prob, n = self.raw_outputs(dispatched)
+    hang_s = getattr(dispatched, 'hang_s', 0.0)
+    if hang_s:
+      # Injected device hang (ENV_DEVICE_HANG_AT_PACK): simulate a
+      # wedged sync so the watchdog path is provable on CPU.
+      dispatched.hang_s = 0.0
+      time.sleep(hang_s)
     # Slice on the host: indexing the device array with a varying [:n]
     # would lower (and cache) a fresh jitted slice per tail size.
     # dclint: allow=jit-hazards (finalize IS the sync point: results
@@ -1507,6 +1691,10 @@ def run_inference(
           window_counter['n_model_packs'] = engine.n_packs
           window_counter['n_model_pack_rows'] = engine.n_pack_rows
           window_counter['n_model_pad_rows'] = engine.n_pad_rows
+          window_counter['n_oom_bisections'] = engine.n_oom_bisections
+          window_counter['n_device_faults'] = engine.n_device_faults
+          window_counter['n_dispatch_timeouts'] = (
+              engine.n_dispatch_timeouts)
           dispatch_stats = getattr(runner, 'dispatch_stats', None)
           if dispatch_stats is not None:
             for key, value in dispatch_stats().items():

@@ -130,11 +130,16 @@ def _make_handler(service: ConsensusService):
         else:
           self._reply_json(503, {'ok': False, 'error': 'model loop died'})
       elif self.path == '/readyz':
+        # Degraded capacity (mesh stepped down a dp level) stays ready
+        # — the service still answers, just slower — but the body says
+        # so, so orchestrators can rebalance replicas.
+        capacity = service.capacity()
         if service.ready:
-          self._reply_json(200, {'ready': True})
+          self._reply_json(200, dict({'ready': True}, **capacity))
         else:
           self._reply_json(
-              503, {'ready': False, 'draining': service._draining})
+              503, dict({'ready': False, 'draining': service._draining},
+                        **capacity))
       elif self.path == '/metricz':
         self._reply_json(200, service.stats())
       else:

@@ -522,6 +522,17 @@ class ConsensusService:
   # ------------------------------------------------------------------
   # Observability
 
+  def capacity(self) -> Dict[str, Any]:
+    """Device capacity for /readyz and /metricz: the current vs launch
+    data-parallel width, and whether the mesh degradation ladder has
+    stepped down (stub runners report a healthy single device)."""
+    runner = self.engine.runner
+    return {
+        'mesh_dp': int(getattr(runner, 'mesh_dp', 0) or 0),
+        'initial_dp': int(getattr(runner, '_initial_dp', 0) or 0),
+        'degraded': bool(getattr(runner, 'is_degraded', False)),
+    }
+
   def latency_percentiles(self) -> Dict[str, Optional[float]]:
     # Snapshot under the lock: sorted() iterates the deque, and a
     # concurrent model-loop append raises "deque mutated during
@@ -551,12 +562,18 @@ class ConsensusService:
     counters.setdefault('n_transfer_overlapped', 0)
     counters.setdefault('n_transfer_direct', 0)
     counters.setdefault('transfer_overlap_fraction', 0.0)
+    # Device fault domain (--on_device_error / --dispatch_timeout).
+    counters.setdefault('n_oom_bisections', 0)
+    counters.setdefault('n_device_faults', 0)
+    counters.setdefault('n_dispatch_timeouts', 0)
+    counters.setdefault('n_mesh_degradations', 0)
     with self._lock:
       outstanding = len(self._outstanding)
     out = {
         'outstanding': outstanding,
         'draining': self._draining,
         'ready': self.ready,
+        'capacity': self.capacity(),
         'faults': counters,
         'latency': self.latency_percentiles(),
         'outcomes': dataclasses.asdict(self.outcome),

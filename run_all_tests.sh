@@ -11,6 +11,9 @@
 #   ./run_all_tests.sh serve       # `dctpu serve` stage only (engine
 #                                  # boundary, service fault drills,
 #                                  # SIGTERM-under-load drain)
+#   ./run_all_tests.sh device      # device fault domain only (typed
+#                                  # XLA faults, dispatch watchdog,
+#                                  # OOM bisection, mesh degradation)
 #   ./run_all_tests.sh multichip   # dp-sharded dispatch tests only,
 #                                  # over the 8 forced host-platform
 #                                  # devices (conftest.py sets
@@ -53,6 +56,10 @@ fi
 
 if [[ "${1:-}" == "serve" ]]; then
   exec scripts/run_resilience.sh --serve
+fi
+
+if [[ "${1:-}" == "device" ]]; then
+  exec scripts/run_resilience.sh --device
 fi
 
 if [[ "${1:-}" == "multichip" ]]; then

@@ -427,6 +427,16 @@ def main(argv: Optional[List[str]] = None) -> int:
           'disconnect, garbage, oversized, slowloris)\n'
           '  DCTPU_FAULT_SERVE_CLIENT_ZMW=<substr>  scope the client '
           'sabotage to molecules whose name contains substr\n'
+          '  DCTPU_FAULT_DEVICE_OOM_AT_PACK=N  raise RESOURCE_EXHAUSTED '
+          'inside the launch of the Nth dispatched pack (1-based; '
+          'fires once) — --on_device_error=degrade bisects it\n'
+          '  DCTPU_FAULT_DEVICE_LOST_AT_PACK=N raise a halted-device '
+          'error at the Nth pack — degrade rebuilds the mesh one dp '
+          'step down and resubmits\n'
+          '  DCTPU_FAULT_DEVICE_HANG_AT_PACK=N hang the Nth pack\'s '
+          'finalize so the --dispatch_timeout watchdog must fire\n'
+          '  DCTPU_FAULT_DEVICE_HANG_S=<secs>  hang duration for '
+          'HANG_AT_PACK (default 30)\n'
       ),
   )
   sub = parser.add_subparsers(dest='command', required=True)
@@ -486,6 +496,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                  default='truncate')
   p.add_argument('--fraction', type=float, default=0.5)
 
+  p = sub.add_parser('device',
+                     help='Arm a device-fault hook (OOM / lost / hang '
+                     'at a pack ordinal) and optionally exec a command '
+                     'under it.')
+  p.add_argument('--fault', required=True, choices=('oom', 'lost', 'hang'))
+  p.add_argument('--pack', type=int, default=1,
+                 help='1-based dispatch ordinal of the targeted pack.')
+  p.add_argument('--hang_s', type=float, default=30.0,
+                 help='hang: seconds the finalize sleeps (pair with '
+                 '--dispatch_timeout below it).')
+  p.add_argument('cmd', nargs=argparse.REMAINDER,
+                 help='Command to exec with the hook armed; without '
+                 'one, print the env assignments to eval.')
+
   p = sub.add_parser('serve_client',
                      help='Adversarial client against a running '
                      '`dctpu serve` daemon.')
@@ -540,6 +564,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(corrupt_checkpoint(args.ckpt, mode=args.mode,
                              fraction=args.fraction))
     return 0
+  if args.command == 'device':
+    from deepconsensus_tpu import faults as faults_lib
+
+    env = {
+        'oom': {faults_lib.ENV_DEVICE_OOM_AT_PACK: str(args.pack)},
+        'lost': {faults_lib.ENV_DEVICE_LOST_AT_PACK: str(args.pack)},
+        'hang': {
+            faults_lib.ENV_DEVICE_HANG_AT_PACK: str(args.pack),
+            faults_lib.ENV_DEVICE_HANG_S: str(args.hang_s),
+        },
+    }[args.fault]
+    cmd = [c for c in args.cmd if c != '--']
+    if not cmd:
+      for key, value in env.items():
+        print(f'export {key}={value}')
+      return 0
+    os.environ.update(env)
+    os.execvp(cmd[0], cmd)
+
   if args.command == 'serve_client':
     from deepconsensus_tpu.serve import client as client_lib
     from deepconsensus_tpu.serve import protocol

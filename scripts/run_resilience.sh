@@ -20,6 +20,11 @@
 #                                         # engine boundary + service
 #                                         # fault drills + the real
 #                                         # SIGTERM-under-load drain
+#   scripts/run_resilience.sh --device    # device fault domain only:
+#                                         # typed XLA faults, dispatch
+#                                         # watchdog, OOM bisection,
+#                                         # mesh degradation (dp 8->4)
+#                                         # incl. byte-identity drills
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -40,6 +45,17 @@ if [[ "${1:-}" == "--serve" ]]; then
   exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_engine.py tests/test_serve.py \
     tests/test_window_packer.py \
+    -q --continue-on-collection-errors "$@"
+fi
+
+if [[ "${1:-}" == "--device" ]]; then
+  shift
+  # The device fault domain in isolation: fault classification, the
+  # dispatch watchdog, OOM bisection, and dp 8->4 mesh degradation
+  # (multichip drills run on the 8 faked CPU devices conftest.py
+  # forces via --xla_force_host_platform_device_count).
+  exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_device_faults.py \
     -q --continue-on-collection-errors "$@"
 fi
 

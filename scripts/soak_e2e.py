@@ -20,6 +20,17 @@ Verdict line reports client-observed p50/p99 latency and the daemon's
 own /metricz counters.
 
   python scripts/soak_e2e.py --serve 8 --serve_rounds 20
+
+Chaos mode (--chaos): same batch soak, but one device OOM and one
+device hang are injected mid-stream via the DCTPU_FAULT_DEVICE_* env
+hooks. The child runs with --on_device_error=degrade and a dispatch
+watchdog, so the OOM pack must recover through batch bisection and the
+hung pack must be cut off by the watchdog (its ZMWs fall back to CCS).
+The verdict gains a 'chaos' block read from the run's .inference.json
+sidecar; exit is nonzero unless both recovery counters fired and
+throughput stayed flat.
+
+  python scripts/soak_e2e.py --chaos --min_minutes 2
 """
 import argparse
 import gzip
@@ -306,10 +317,34 @@ def main():
   ap.add_argument('--serve_batch_size', type=int, default=64,
                   help='Serve mode: daemon pack size (every pack pads '
                   'to this compiled shape; keep small on CPU hosts).')
+  ap.add_argument('--batch_size', type=int, default=0,
+                  help='Batch mode: child pack size (0 = library '
+                  'default of 1024). Chaos mode forces 64 when unset '
+                  'so the soak spans many packs and per-pack compute '
+                  'stays well under --dispatch_timeout.')
+  ap.add_argument('--chaos', action='store_true',
+                  help='Inject one device OOM and one device hang '
+                  'mid-soak; the run must complete via bisection + '
+                  'watchdog with recovery counters in the verdict.')
+  ap.add_argument('--chaos_oom_pack', type=int, default=3,
+                  help='Chaos mode: 1-based dispatch ordinal of the '
+                  'pack that fakes RESOURCE_EXHAUSTED.')
+  ap.add_argument('--chaos_hang_pack', type=int, default=6,
+                  help='Chaos mode: 1-based dispatch ordinal of the '
+                  'pack whose finalize hangs.')
+  ap.add_argument('--chaos_hang_s', type=float, default=6.0,
+                  help='Chaos mode: how long the hung pack sleeps '
+                  '(must exceed --dispatch_timeout).')
+  ap.add_argument('--dispatch_timeout', type=float, default=2.0,
+                  help='Chaos mode: watchdog bound on the blocking '
+                  'device sync in the child.')
   args = ap.parse_args()
 
   if args.serve > 0:
     return serve_soak(args)
+
+  if args.chaos and not args.batch_size:
+    args.batch_size = 64
 
   os.makedirs(args.out_dir, exist_ok=True)
   # Hosts without the reference testdata fall back to deterministic
@@ -368,9 +403,13 @@ def main():
         'model = model_lib.get_model(params)\n'
         'variables = model.init(jax.random.PRNGKey(0), jnp.zeros(\n'
         '    (1, params.total_rows, params.max_length, 1)))\n'
-        'sub, ccs, out, bz = sys.argv[1:5]\n'
+        'sub, ccs, out, bz, bs, ode, dt, oze = sys.argv[1:9]\n'
         'options = runner_lib.InferenceOptions(\n'
-        '    batch_zmws=int(bz), cpus=0, min_quality=0)\n'
+        '    batch_zmws=int(bz), cpus=0, min_quality=0,\n'
+        '    on_device_error=ode, dispatch_timeout=float(dt),\n'
+        '    on_zmw_error=oze)\n'
+        'if int(bs):\n'
+        '  options.batch_size = int(bs)\n'
         'runner = runner_lib.ModelRunner(params, variables, options)\n'
         'runner_lib.run_inference(subreads_to_ccs=sub, ccs_bam=ccs,\n'
         '    checkpoint=None, output=out, options=options,\n'
@@ -379,6 +418,12 @@ def main():
     cmd = [
         sys.executable, '-c', child_code,
         sub_bam, ccs_bam, out_fastq, str(args.batch_zmws),
+        str(args.batch_size),
+        'degrade' if args.chaos else 'fail',
+        str(args.dispatch_timeout if args.chaos else 0.0),
+        # A watchdogged hang is never retried — its ZMWs must fall back
+        # to CCS instead of aborting the whole soak.
+        'ccs-fallback' if args.chaos else 'fail',
     ]
   else:
     child_code = (
@@ -394,8 +439,29 @@ def main():
         '--batch_zmws', str(args.batch_zmws),
         '--skip_windows_above', '0', '--min_quality', '0',
     ]
+    if args.batch_size:
+      cmd += ['--batch_size', str(args.batch_size)]
+    if args.chaos:
+      cmd += ['--on_device_error', 'degrade',
+              '--dispatch_timeout', str(args.dispatch_timeout),
+              '--on_zmw_error', 'ccs-fallback']
   env = dict(os.environ)
   env['PYTHONPATH'] = '/root/repo:' + env.get('PYTHONPATH', '')
+  if args.chaos:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from deepconsensus_tpu import faults as shared_faults
+
+    env[shared_faults.ENV_DEVICE_OOM_AT_PACK] = str(args.chaos_oom_pack)
+    env[shared_faults.ENV_DEVICE_HANG_AT_PACK] = str(args.chaos_hang_pack)
+    env[shared_faults.ENV_DEVICE_HANG_S] = str(args.chaos_hang_s)
+    print(json.dumps({
+        'chaos': 'armed',
+        'oom_at_pack': args.chaos_oom_pack,
+        'hang_at_pack': args.chaos_hang_pack,
+        'hang_s': args.chaos_hang_s,
+        'dispatch_timeout': args.dispatch_timeout,
+    }), flush=True)
   proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
                           stderr=subprocess.STDOUT)
 
@@ -465,7 +531,29 @@ def main():
       'ran_minutes': round(wall / 60, 1),
       'long_enough': wall >= args.min_minutes * 60,
   }
+  if args.chaos:
+    counters = {}
+    sidecar = out_fastq + '.inference.json'
+    if os.path.exists(sidecar):
+      with open(sidecar) as f:
+        counters = json.load(f)
+    chaos = {
+        'n_device_faults': counters.get('n_device_faults', 0),
+        'n_oom_bisections': counters.get('n_oom_bisections', 0),
+        'n_dispatch_timeouts': counters.get('n_dispatch_timeouts', 0),
+        'n_mesh_degradations': counters.get('n_mesh_degradations', 0),
+        'n_zmw_quarantined': counters.get('n_zmw_quarantined', 0),
+    }
+    chaos['recovered'] = bool(
+        rc == 0 and chaos['n_oom_bisections'] >= 1
+        and chaos['n_dispatch_timeouts'] >= 1)
+    verdict['chaos'] = chaos
   print(json.dumps(verdict), flush=True)
+  if args.chaos:
+    # Recovery counters are the point; flatness only judges runs long
+    # enough to have quartiles that mean something.
+    flat_ok = verdict['throughput_flat'] or len(rates) < 4
+    return 0 if verdict['chaos']['recovered'] and flat_ok else 1
   return 0 if rc == 0 else rc
 
 

@@ -1,0 +1,531 @@
+"""Device fault domain: classification, watchdog, bisection, mesh fallback.
+
+Every injected fault here fires inside the REAL ModelRunner dispatch
+path (faults.injected_device_fault / injected_device_hang live in
+_launch), so these tests use random-init weights rather than the stub
+runners other suites lean on. The mesh tests run on the 8 forced
+host-platform devices (tests/conftest.py), proving the acceptance
+criterion on CPU: a dp=8 run losing a device mid-stream degrades to
+dp=4, resubmits the failed pack, and stays byte-identical to a clean
+single-device run — for both the engine and the resident service.
+
+The device hooks are consume-once per PROCESS (faults._fired), so every
+clean baseline runs BEFORE its env hook is armed, and the `arm` fixture
+re-arms the latch on teardown for later tests in the same process.
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu.inference import engine as engine_lib
+from deepconsensus_tpu.inference import faults as inf_faults
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+
+pytestmark = pytest.mark.resilience
+
+BATCH = 8
+
+
+@pytest.fixture(scope='module')
+def params():
+  p = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(p, is_training=False)
+  return p
+
+
+@pytest.fixture(scope='module')
+def variables(params):
+  return model_lib.get_model(params).init(
+      jax.random.PRNGKey(0),
+      jnp.zeros((1, params.total_rows, params.max_length, 1)))
+
+
+@pytest.fixture
+def arm(monkeypatch):
+  """Arms a device-fault env hook; teardown re-arms the consume-once
+  latch so the same hook can fire again in a later test."""
+
+  def _arm(name, value):
+    monkeypatch.setenv(name, str(value))
+
+  yield _arm
+  for name in (shared_faults.ENV_DEVICE_OOM_AT_PACK,
+               shared_faults.ENV_DEVICE_LOST_AT_PACK,
+               shared_faults.ENV_DEVICE_HANG_AT_PACK):
+    shared_faults._fired.discard(name)
+
+
+@pytest.fixture
+def inject(scripts_importable):
+  from scripts import inject_faults
+  return inject_faults
+
+
+def _dev_runner(params, variables, mesh=None, **kw):
+  kw.setdefault('batch_size', BATCH)
+  options = runner_lib.InferenceOptions(**kw)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  return runner_lib.ModelRunner(params, variables, options,
+                                mesh=mesh), options
+
+
+def _collecting_engine(runner, options):
+  delivered = {}
+  failures = []
+  engine = engine_lib.ConsensusEngine(
+      runner, options,
+      deliver=lambda t, ids, quals: delivered.__setitem__(t, (ids, quals)),
+      on_pack_failure=lambda ts, seq, e: failures.append((list(ts), seq, e)))
+  return engine, delivered, failures
+
+
+def _raw_windows(params, n, seed=0):
+  rng = np.random.default_rng(seed)
+  shape = (n, params.total_rows, params.max_length, 1)
+  return rng.integers(0, 5, size=shape).astype(np.float32)
+
+
+def _fastq_names(path):
+  with open(path) as f:
+    return [line.rstrip('\n')[1:] for line in f if line.startswith('@')]
+
+
+# ----------------------------------------------------------------------
+# Classification: XlaRuntimeError text -> typed DeviceFault family
+
+
+class TestClassification:
+
+  def test_resource_exhausted_wraps_transient_oom(self):
+    err = RuntimeError('RESOURCE_EXHAUSTED: out of memory allocating '
+                       '8589934592 bytes')
+    wrapped = shared_faults.classify_device_error(err)
+    assert isinstance(wrapped, shared_faults.DeviceOomError)
+    assert wrapped.kind == shared_faults.FaultKind.TRANSIENT
+    assert wrapped.__cause__ is err
+
+  @pytest.mark.parametrize('text', [
+      'DATA_LOSS: device out of sync',
+      'INTERNAL: compiled program failed',
+      'slice 3 core halted unexpectedly',
+  ])
+  def test_lost_markers_wrap_permanent(self, text):
+    wrapped = shared_faults.classify_device_error(RuntimeError(text))
+    assert isinstance(wrapped, shared_faults.DeviceLostError)
+    assert wrapped.kind == shared_faults.FaultKind.PERMANENT
+
+  def test_unrelated_error_passes_through(self):
+    err = ValueError('bad window shape')
+    assert shared_faults.classify_device_error(err) is err
+
+  def test_already_typed_fault_is_idempotent(self):
+    err = shared_faults.DeviceOomError('pack too big')
+    assert shared_faults.classify_device_error(err) is err
+
+  def test_dispatch_timeout_is_transient_watchdog(self):
+    err = shared_faults.DispatchTimeoutError(
+        'pack finalize produced no result within dispatch_timeout=5.0s')
+    assert 'watchdog' in str(err)
+    assert err.kind == shared_faults.FaultKind.TRANSIENT
+
+  def test_fault_family_registered_with_dclint(self, scripts_importable):
+    """typed-faults zero-baseline: the DeviceFault family must be in
+    the linter's FAULT_TYPES so raises of these types stay clean."""
+    from tools.dclint import config as dclint_config
+    assert {'DeviceFault', 'DeviceOomError', 'DeviceLostError',
+            'DispatchTimeoutError'} <= set(dclint_config.FAULT_TYPES)
+
+  def test_inject_faults_device_subcommand_prints_env(self, inject,
+                                                      capsys):
+    assert inject.main(['device', '--fault', 'hang', '--pack', '3',
+                        '--hang_s', '7.5']) == 0
+    out = capsys.readouterr().out
+    assert f'export {shared_faults.ENV_DEVICE_HANG_AT_PACK}=3' in out
+    assert f'export {shared_faults.ENV_DEVICE_HANG_S}=7.5' in out
+    assert inject.main(['device', '--fault', 'oom', '--pack', '2']) == 0
+    out = capsys.readouterr().out
+    assert f'export {shared_faults.ENV_DEVICE_OOM_AT_PACK}=2' in out
+
+
+# ----------------------------------------------------------------------
+# Engine policy: fail mode surfaces, degrade mode recovers
+
+
+def test_fail_mode_surfaces_typed_fault_without_retry(params, variables,
+                                                      arm):
+  """--on_device_error=fail (the default): the classified fault routes
+  to on_pack_failure untouched — no bisection, no degradation."""
+  runner, options = _dev_runner(params, variables)
+  engine, delivered, failures = _collecting_engine(runner, options)
+  arm(shared_faults.ENV_DEVICE_OOM_AT_PACK, 1)
+  engine.submit(_raw_windows(params, BATCH, seed=20), list(range(BATCH)))
+  engine.flush()
+  assert len(failures) == 1
+  tickets, seq, err = failures[0]
+  assert tickets == list(range(BATCH)) and seq == 0
+  assert isinstance(err, shared_faults.DeviceOomError)
+  assert engine.n_device_faults == 1
+  assert engine.n_oom_bisections == 0
+  assert not delivered
+
+
+def test_oom_bisection_byte_identical(params, variables, arm):
+  """degrade mode: an OOM pack retries as halves at half batch shape
+  and every window still gets exactly its clean result."""
+  raw = _raw_windows(params, 2 * BATCH + 3, seed=21)
+  runner_a, options_a = _dev_runner(params, variables)
+  baseline = engine_lib.ConsensusEngine(
+      runner_a, options_a,
+      deliver=lambda t, ids, quals: None).predict_windows(raw)
+  arm(shared_faults.ENV_DEVICE_OOM_AT_PACK, 1)
+  runner_b, options_b = _dev_runner(params, variables,
+                                    on_device_error='degrade')
+  engine = engine_lib.ConsensusEngine(
+      runner_b, options_b, deliver=lambda t, ids, quals: None)
+  ids, quals = engine.predict_windows(raw)
+  np.testing.assert_array_equal(ids, baseline[0])
+  np.testing.assert_array_equal(quals, baseline[1])
+  assert engine.n_oom_bisections == 1
+  assert engine.n_device_faults == 1
+  assert engine.stats()['n_oom_bisections'] == 1
+
+
+def test_hang_bounded_by_dispatch_watchdog(params, variables, arm):
+  """A wedged finalize (injected 6s hang) becomes DispatchTimeoutError
+  within --dispatch_timeout + slack, attributed to the hung pack;
+  sibling packs deliver. Timeouts are never retried, even under
+  degrade (a hung device would hang again)."""
+  runner, options = _dev_runner(params, variables, dispatch_timeout=1.0,
+                                on_device_error='degrade')
+  engine, delivered, failures = _collecting_engine(runner, options)
+  arm(shared_faults.ENV_DEVICE_HANG_AT_PACK, 1)
+  arm(shared_faults.ENV_DEVICE_HANG_S, 6.0)
+  engine.submit(_raw_windows(params, 2 * BATCH, seed=22),
+                list(range(2 * BATCH)))
+  t0 = time.monotonic()
+  engine.flush()
+  elapsed = time.monotonic() - t0
+  # Bound: the 1.0s watchdog plus generous slack, well under the 6s
+  # injected hang — without the watchdog this flush takes 6+ seconds.
+  assert elapsed < 4.5, f'watchdog did not bound the hang: {elapsed:.1f}s'
+  assert len(failures) == 1
+  tickets, seq, err = failures[0]
+  assert tickets == list(range(BATCH)) and seq == 0
+  assert isinstance(err, shared_faults.DispatchTimeoutError)
+  assert engine.n_dispatch_timeouts == 1
+  assert engine.n_device_faults == 1
+  assert engine.n_oom_bisections == 0
+  assert set(delivered) == set(range(BATCH, 2 * BATCH))
+
+
+# ----------------------------------------------------------------------
+# Mesh degradation ladder (8 forced host-platform devices)
+
+
+@pytest.mark.multichip
+def test_lost_device_degrades_mesh_byte_identical(params, variables, arm):
+  """The acceptance core at the engine boundary: dp=8 loses a "device"
+  mid-stream, degrades to dp=4, resubmits the failed pack, and the
+  output is byte-identical to a clean single-device run."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  raw = _raw_windows(params, 2 * BATCH + 5, seed=31)
+  runner_s, options_s = _dev_runner(params, variables)
+  baseline = engine_lib.ConsensusEngine(
+      runner_s, options_s,
+      deliver=lambda t, ids, quals: None).predict_windows(raw)
+
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  runner_m, options_m = _dev_runner(params, variables, mesh=mesh,
+                                    on_device_error='degrade')
+  engine = engine_lib.ConsensusEngine(
+      runner_m, options_m, deliver=lambda t, ids, quals: None)
+  assert runner_m.mesh_dp == 8 and not runner_m.is_degraded
+  arm(shared_faults.ENV_DEVICE_LOST_AT_PACK, 2)
+  ids, quals = engine.predict_windows(raw)
+  np.testing.assert_array_equal(ids, baseline[0])
+  np.testing.assert_array_equal(quals, baseline[1])
+  assert runner_m.mesh_dp == 4
+  assert runner_m.is_degraded
+  assert engine.n_device_faults == 1
+  stats = engine.stats()
+  assert stats['n_mesh_degradations'] == 1
+  assert stats['mesh_dp'] == 4
+
+
+@pytest.mark.multichip
+def test_oom_bisection_floors_at_dp_divisibility(params, variables, arm):
+  """batch 8 over dp=8 cannot bisect (half of 8 does not split over 8
+  devices): the OOM routes to on_pack_failure instead of looping."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  runner, options = _dev_runner(params, variables, mesh=mesh,
+                                on_device_error='degrade')
+  engine, delivered, failures = _collecting_engine(runner, options)
+  arm(shared_faults.ENV_DEVICE_OOM_AT_PACK, 1)
+  engine.submit(_raw_windows(params, BATCH, seed=32), list(range(BATCH)))
+  engine.flush()
+  assert len(failures) == 1
+  assert isinstance(failures[0][2], shared_faults.DeviceOomError)
+  assert engine.n_oom_bisections == 0
+  assert not delivered
+  assert runner.mesh_dp == 8  # OOM never touches the mesh ladder
+
+
+@pytest.mark.multichip
+def test_run_inference_mid_stream_degradation_byte_identical(
+    params, variables, arm, synthetic_bams, tmp_path):
+  """End-to-end acceptance (engine variant): the batch pipeline on a
+  dp=8 mesh loses a device mid-stream, degrades, completes, and the
+  FASTQ is byte-identical to a clean single-device run — with the
+  recovery counters in the run's own stats."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  subreads, ccs = synthetic_bams(subdir='bams_device', n_zmws=6,
+                                 seq_len=600)
+  run_kw = dict(batch_zmws=100, skip_windows_above=0, min_quality=0)
+
+  ref_out = str(tmp_path / 'ref.fastq')
+  runner_s, options_s = _dev_runner(params, variables, **run_kw)
+  runner_lib.run_inference(subreads, ccs, None, ref_out,
+                           options=options_s, runner=runner_s)
+
+  arm(shared_faults.ENV_DEVICE_LOST_AT_PACK, 2)
+  out = str(tmp_path / 'degraded.fastq')
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  runner_m, options_m = _dev_runner(params, variables, mesh=mesh,
+                                    on_device_error='degrade', **run_kw)
+  counters = runner_lib.run_inference(subreads, ccs, None, out,
+                                      options=options_m, runner=runner_m)
+  assert counters['success'] == 6
+  assert counters['n_device_faults'] == 1
+  assert counters['n_mesh_degradations'] == 1
+  assert counters['mesh_dp'] == 4
+  assert counters.get('n_zmw_quarantined', 0) == 0
+  with open(ref_out, 'rb') as a, open(out, 'rb') as b:
+    assert a.read() == b.read()
+
+
+# ----------------------------------------------------------------------
+# Abort + resume, and dead-letter attribution, after device faults
+
+
+def test_resume_after_device_fault_abort(params, variables, arm,
+                                         monkeypatch, synthetic_bams,
+                                         tmp_path):
+  """fail-mode abort mid-run on a device fault: the manifest stays
+  consistent, --resume completes the run, and no ZMW is emitted twice."""
+  subreads, ccs = synthetic_bams(subdir='bams_resume', n_zmws=6,
+                                 seq_len=600)
+  # depth 1 drains packs eagerly (the default depth of 8 would hold
+  # every pack in flight until the final flush, so the abort would land
+  # before any group committed — a valid but progress-free manifest);
+  # emit depth 1 makes the first group's commit happen-before the
+  # second emit_put returns, so groups_done >= 1 is deterministic.
+  run_kw = dict(batch_zmws=2, skip_windows_above=0, min_quality=0,
+                dispatch_depth=1, emit_queue_depth=1)
+
+  ref_out = str(tmp_path / 'ref.fastq')
+  runner1, options1 = _dev_runner(params, variables, **run_kw)
+  runner_lib.run_inference(subreads, ccs, None, ref_out,
+                           options=options1, runner=runner1)
+
+  out = str(tmp_path / 'out.fastq')
+  arm(shared_faults.ENV_DEVICE_LOST_AT_PACK, 4)
+  runner2, options2 = _dev_runner(params, variables, **run_kw)
+  with pytest.raises(inf_faults.DeviceLostError, match='halted'):
+    runner_lib.run_inference(subreads, ccs, None, out,
+                             options=options2, runner=runner2)
+  monkeypatch.delenv(shared_faults.ENV_DEVICE_LOST_AT_PACK)
+  assert not os.path.exists(out)
+  assert os.path.exists(out + '.tmp')
+  manifest = json.load(open(out + '.progress.json'))
+  assert manifest['groups_done'] >= 1
+  assert json.load(open(out + '.inference.json')).get('partial') is True
+
+  runner3, options3 = _dev_runner(params, variables, resume=True,
+                                  **run_kw)
+  counters = runner_lib.run_inference(subreads, ccs, None, out,
+                                      options=options3, runner=runner3)
+  assert counters['n_zmw_resume_skipped'] >= 1
+  assert 'partial' not in counters
+  assert not os.path.exists(out + '.progress.json')
+  assert not os.path.exists(out + '.tmp')
+  got = sorted(_fastq_names(out))
+  assert got == sorted(_fastq_names(ref_out))
+  assert len(got) == len(set(got)), 'duplicate ZMWs after resume'
+
+
+def test_device_fault_dead_letter_carries_kind(params, variables, arm,
+                                               synthetic_bams, tmp_path):
+  """Quarantined pack failures keep the device-fault classification:
+  the dead-letter line names the typed fault and its permanent kind."""
+  subreads, ccs = synthetic_bams(subdir='bams_dl', n_zmws=6, seq_len=600)
+  out = str(tmp_path / 'out.fastq')
+  arm(shared_faults.ENV_DEVICE_LOST_AT_PACK, 2)
+  runner, options = _dev_runner(params, variables, batch_zmws=2,
+                                skip_windows_above=0, min_quality=0,
+                                on_zmw_error='ccs-fallback')
+  counters = runner_lib.run_inference(subreads, ccs, None, out,
+                                      options=options, runner=runner)
+  assert counters['n_device_faults'] == 1
+  assert counters['n_zmw_quarantined'] >= 1
+  assert len(_fastq_names(out)) == 6  # fallbacks emitted, none lost
+  letters = [e for e in inf_faults.read_dead_letters(out + '.failed.jsonl')
+             if e['stage'] == 'model']
+  assert letters
+  for entry in letters:
+    assert 'DeviceLostError' in entry['error']
+    assert entry['kind'] == shared_faults.FaultKind.PERMANENT
+    assert entry['action'] == 'ccs-fallback'
+
+
+# ----------------------------------------------------------------------
+# Resident service: degraded capacity, bisection counters, drain
+
+
+def _mol(params, name, n=4, seed=0):
+  rng = np.random.default_rng(seed)
+  return dict(
+      name=name,
+      subreads=rng.integers(
+          0, 5, size=(n, params.total_rows, params.max_length, 1)
+      ).astype(np.float32),
+      window_pos=np.arange(n, dtype=np.int64) * params.max_length,
+      ccs_bq=np.full((n, params.max_length), 30, dtype=np.int32),
+      overflow=np.zeros(n, dtype=np.uint8),
+  )
+
+
+@contextlib.contextmanager
+def _serving(params, variables, mesh=None, serve_kw=None, **opt_kw):
+  from deepconsensus_tpu.serve import server as server_lib
+  from deepconsensus_tpu.serve.client import ServeClient
+  from deepconsensus_tpu.serve.service import (ConsensusService,
+                                               ServeOptions)
+
+  opt_kw.setdefault('min_quality', 0)
+  opt_kw.setdefault('min_length', 0)
+  runner, options = _dev_runner(params, variables, mesh=mesh, **opt_kw)
+  so_kw = dict(io_timeout_s=2.0)
+  so_kw.update(serve_kw or {})
+  service = ConsensusService(runner, options, ServeOptions(**so_kw))
+  service.warmup()  # consumes dispatch ordinal 1
+  service.start()
+  httpd = server_lib.build_server(service, '127.0.0.1', 0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  try:
+    yield service, ServeClient(port=httpd.server_address[1], timeout=30)
+  finally:
+    service.begin_drain()
+    httpd.shutdown()
+    httpd.server_close()
+    service.drain(timeout=15)
+
+
+@pytest.mark.multichip
+def test_serve_degrades_mid_stream_byte_identical(params, variables, arm):
+  """Acceptance (serve variant): the resident service loses a mesh
+  device under live traffic, degrades to dp=4, and every response
+  stays byte-identical to the single-device service — while /readyz
+  stays 200 and reports the reduced capacity."""
+  from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+  mols = [_mol(params, f'm/{i}/ccs', n=3 + i % 4, seed=i)
+          for i in range(6)]
+
+  def serve_all(mesh, **opt_kw):
+    with _serving(params, variables, mesh=mesh, **opt_kw) as (
+        service, client):
+      assert client.wait_ready(10)
+      responses = [client.polish(**m) for m in mols]
+      return responses, client.metricz(), client.readyz()
+
+  single, _, _ = serve_all(None)
+  # Warmup is dispatch ordinal 1; the first polished pack is 2.
+  arm(shared_faults.ENV_DEVICE_LOST_AT_PACK, 2)
+  mesh = mesh_lib.make_mesh(dp=8, tp=1, devices=jax.devices()[:8])
+  sharded, metrics, ready = serve_all(mesh, on_device_error='degrade')
+
+  for i, (s, m) in enumerate(zip(single, sharded)):
+    assert m['status'] == s['status'] == 'ok', i
+    assert m['seq'] == s['seq'], i
+    np.testing.assert_array_equal(m['quals'], s['quals'])
+  assert ready['_status'] == 200  # degraded capacity stays ready
+  assert ready['degraded'] is True
+  assert ready['mesh_dp'] == 4
+  assert ready['initial_dp'] == 8
+  faults = metrics['faults']
+  assert faults['n_device_faults'] == 1
+  assert faults['n_mesh_degradations'] == 1
+  assert metrics['capacity']['degraded'] is True
+
+
+def test_serve_oom_bisection_in_metricz(params, variables, arm):
+  """An OOM pack under the service bisects transparently: the request
+  succeeds with its clean bytes and /metricz shows the bisection."""
+  mol = _mol(params, 'm/1/ccs', n=4, seed=3)
+  with _serving(params, variables,
+                on_device_error='degrade') as (service, client):
+    assert client.wait_ready(10)
+    clean = client.polish(**mol)  # dispatch ordinal 2
+    arm(shared_faults.ENV_DEVICE_OOM_AT_PACK, 3)
+    chaos = client.polish(**mol)  # ordinal 3: the OOM pack
+    assert chaos['status'] == 'ok'
+    assert chaos['seq'] == clean['seq']
+    np.testing.assert_array_equal(chaos['quals'], clean['quals'])
+    m = client.metricz()
+    assert m['faults']['n_oom_bisections'] == 1
+    assert m['faults']['n_device_faults'] == 1
+    ready = client.readyz()
+    assert ready['degraded'] is False  # bisection is not degradation
+
+
+def test_serve_drain_resolves_device_fault_on_final_pack(params,
+                                                         variables, arm):
+  """Drain audit regression: a deferred-launch device fault on the
+  LAST in-flight pack during drain must neither hang the drain nor
+  lose the admitted request (it resolves via the isolation retry)."""
+  from deepconsensus_tpu.serve import protocol
+  from deepconsensus_tpu.serve.service import (ConsensusService,
+                                               ServeOptions)
+
+  runner, options = _dev_runner(params, variables, min_quality=0,
+                                min_length=0)
+  service = ConsensusService(
+      runner, options,
+      ServeOptions(io_timeout_s=2.0, on_request_error='ccs-fallback'))
+  service.warmup()  # dispatch ordinal 1
+  mol = _mol(params, 'm/9/ccs', n=3, seed=5)
+  req = protocol.decode_request(
+      protocol.encode_request(**mol),
+      total_rows=params.total_rows, max_length=params.max_length,
+      max_windows=64)
+  # Admit BEFORE the loop starts, then drain: the request's own pack
+  # (ordinal 2) is the final in-flight handle of the drain.
+  state = service.submit(req, None)
+  arm(shared_faults.ENV_DEVICE_LOST_AT_PACK, 2)
+  service.begin_drain()
+  service.start()
+  assert service.drain(timeout=30), 'drain hung on the faulted pack'
+  result = service.wait(state)
+  # Accepted-then-recovered, not accepted-then-lost: the consume-once
+  # fault fails the shared pack, the isolation retry succeeds.
+  assert result['status'] == 'ok'
+  assert service._loop_error is None
+  stats = service.stats()
+  assert stats['faults']['n_device_faults'] == 1
+  assert stats['faults']['n_isolation_retries'] >= 1

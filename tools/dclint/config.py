@@ -45,6 +45,10 @@ FAULT_TYPES = frozenset({
     'CrashLoopError',
     'NonFiniteTrainingError',
     'ExportedArtifactMismatchError',
+    'DeviceFault',
+    'DeviceOomError',
+    'DeviceLostError',
+    'DispatchTimeoutError',
     # deepconsensus_tpu/inference/faults.py
     'ZmwFault',
     'WatchdogTimeout',
@@ -95,12 +99,12 @@ JIT_SCOPE = (
 # continuous-batching latency directly.
 HOT_FUNCTIONS = {
     'deepconsensus_tpu/inference/engine.py': frozenset({
-        'add', '_cut_packs', '_dispatch', '_drain_one', 'flush',
-        'submit', 'submit_formatted',
+        'add', '_cut_packs', '_dispatch', '_drain_one', '_deliver_pack',
+        'flush', 'submit', 'submit_formatted',
     }),
     'deepconsensus_tpu/inference/runner.py': frozenset({
-        'dispatch', 'finalize', 'predict', '_launch', '_launch_pending',
-        'raw_outputs',
+        'dispatch', 'finalize', '_finalize_sync', 'predict', '_launch',
+        '_launch_pending', 'raw_outputs',
     }),
     'deepconsensus_tpu/serve/service.py': frozenset({
         '_model_loop', '_ingest', '_deliver', '_process_retries',
@@ -120,6 +124,8 @@ DEVICE_SOURCE_CALLS = frozenset({
 # `raw_outputs`, and `_launch` receives the in-flight handle).
 DEVICE_PARAMS = {
     ('deepconsensus_tpu/inference/runner.py', 'finalize'): frozenset(
+        {'dispatched'}),
+    ('deepconsensus_tpu/inference/runner.py', '_finalize_sync'): frozenset(
         {'dispatched'}),
     ('deepconsensus_tpu/inference/runner.py', 'raw_outputs'): frozenset(
         {'dispatched'}),
