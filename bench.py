@@ -388,6 +388,12 @@ def main():
                     'in interpret mode — not a meaningful A/B; see '
                     'tests/test_fused_hotpath.py for CPU parity')
     }
+    details['stages']['forward_quant'] = {
+        'skipped': ('CPU fallback: the quant-lever A/B routes through '
+                    'the fused Pallas blocks (interpret mode on CPU) — '
+                    'not a meaningful A/B; accuracy gates run in '
+                    'run_all_tests.sh quant')
+    }
     _write_details(details)
     if budget_left() > 120:
       _e2e_stage(details, repeats=2)
@@ -485,6 +491,18 @@ def main():
     except Exception as e:
       details['stages']['forward_b1024_fused'] = {'error': repr(e)[:200]}
       _write_details(details)
+
+  # Stage 5c: quantized-inference levers on the distilled student
+  # (round-10): f32 vs bf16 vs int8 vs both, every variant routed
+  # through the full-encoder fused blocks at b1024 on the SAME initial
+  # weights, so the lever is the only change between entries.
+  # Details-only — the 5-layer student is a different model from the
+  # headline test config, so its windows/s must never upgrade the
+  # forward metric line. Busy-host guarded per-stage: the student sweep
+  # runs late in the child, so the stage re-samples other-PID CPU use
+  # rather than trusting the capture-start sample.
+  if budget_left() > 150:
+    _quant_forward_stage(details, budget_left)
 
   # Stage 6: training throughput (full train step, batch 256), scan DP
   # vs Pallas wavefront-VJP loss. Opportunistic: the train-step compile
@@ -622,6 +640,83 @@ def main():
     print(json.dumps(e2e_line), flush=True)
   else:
     print(json.dumps(_forward_line(wps, batch, False)), flush=True)
+
+
+def _quant_forward_stage(details, budget_left, batch=1024, n_iters=10):
+  """f32/bf16/int8 forward A/B on the distilled student (b1024, fused
+  encoder blocks). Speedups are reported against the stage's own f32
+  variant — same weights, same fused routing — so they isolate the
+  quantization lever from the fusion lever (forward_b1024_fused owns
+  fused-vs-XLA). MFU per variant comes from compiled-flops when the
+  backend's cost model serves it; int8 variants also record the
+  quantized-matmul count as a wiring check (6 per full block)."""
+  import jax
+  import jax.numpy as jnp
+
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+  from deepconsensus_tpu.models import quantize as quantize_lib
+
+  try:
+    sp = config_lib.get_config('transformer_learn_values_distill+test')
+    config_lib.finalize_params(sp, is_training=False)
+    rows = jnp.asarray(_make_rows(sp, batch, seed=7))
+    vars_f32 = model_lib.get_model(sp).init(
+        jax.random.PRNGKey(0), rows[:1])
+  except Exception as e:
+    details['stages']['forward_quant'] = {'error': repr(e)[:200]}
+    _write_details(details)
+    return
+  frac = _other_pids_busy_frac()
+  stage = {
+      'model': 'transformer_learn_values_distill',
+      'batch': batch,
+      'host_busy_frac_other_pids': (
+          round(frac, 3) if frac is not None else None),
+      'variants': {},
+  }
+  if frac is not None and frac > _BUSY_THRESHOLD:
+    stage['note'] = (f'HOST CONTENDED: other PIDs at {frac:.0%} CPU — '
+                    'variant ratios unreliable this capture')
+  base_wps = None
+  for name, levers in (
+      ('f32', {}),
+      ('bf16', {'inference_dtype': 'bfloat16'}),
+      ('int8', {'quantize_matmuls': 'int8'}),
+      ('bf16_int8', {'inference_dtype': 'bfloat16',
+                     'quantize_matmuls': 'int8'}),
+  ):
+    if budget_left() < 90:
+      stage['variants'][name] = {'error': 'skipped: bench budget exhausted'}
+      continue
+    try:
+      vp = config_lib.get_config('transformer_learn_values_distill+test')
+      with vp.unlocked():
+        vp.use_fused_hotpath = True
+        if 'inference_dtype' in levers:
+          vp.inference_dtype = levers['inference_dtype']
+          vp.dtype = levers['inference_dtype']
+        if 'quantize_matmuls' in levers:
+          vp.quantize_matmuls = levers['quantize_matmuls']
+      config_lib.finalize_params(vp, is_training=False)
+      model_v = model_lib.get_model(vp)
+      vars_v, n_quantized = quantize_lib.prepare_inference_variables(
+          vars_f32, vp)
+      wps, flops = _time_forward(model_v, vars_v, rows, n_iters=n_iters)
+      entry = {'windows_per_sec': round(wps, 1),
+               'n_quantized_matmuls': n_quantized,
+               'host_load': _host_load()}
+      if flops:
+        entry['mfu'] = round(wps / batch * flops / PEAK_BF16_FLOPS, 4)
+      if name == 'f32':
+        base_wps = wps
+      elif base_wps:
+        entry['speedup_vs_f32'] = round(wps / base_wps, 3)
+      stage['variants'][name] = entry
+    except Exception as e:
+      stage['variants'][name] = {'error': repr(e)[:200]}
+    details['stages']['forward_quant'] = stage
+    _write_details(details)
 
 
 def _featurize_stage(details):

@@ -145,7 +145,22 @@ def _add_run(sub):
                  'a record claiming more than this many bytes is '
                  'treated as corrupt (quarantined under '
                  '--on_zmw_error=skip) instead of allocated.')
+  _add_quant_flags(p)
   _add_device_fault_flags(p)
+
+
+def _add_quant_flags(p):
+  p.add_argument('--inference_dtype', default=None,
+                 choices=['float32', 'bfloat16'],
+                 help='Inference weight/activation dtype: bfloat16 '
+                 'casts checkpoint weights once at load and runs the '
+                 'model end-to-end in bf16 (softmax accumulation '
+                 'stays f32). Default keeps the checkpoint dtype.')
+  p.add_argument('--quantize_matmuls', default=None,
+                 choices=['none', 'int8'],
+                 help='int8: per-channel symmetric weight '
+                 'quantization of the encoder attention/FFN matmuls '
+                 'at load; dequant runs in the fused-kernel epilogue.')
 
 
 def _add_device_fault_flags(p):
@@ -226,6 +241,7 @@ def _add_serve(sub):
                  help='Tensor-parallel devices per replica (model-axis '
                  'sharded attention/FFN weights); exported artifacts '
                  'require tp=1.')
+  _add_quant_flags(p)
   _add_device_fault_flags(p)
 
 
@@ -345,6 +361,7 @@ def _add_export(sub):
   p.add_argument('--strict_polymorphic', action='store_true',
                  help='Fail instead of falling back to a fixed-batch '
                  'artifact when batch-polymorphic export fails.')
+  _add_quant_flags(p)
 
 
 def _add_distill(sub):
@@ -546,6 +563,8 @@ def _dispatch(args) -> int:
         max_base_quality=args.max_base_quality,
         on_device_error=args.on_device_error,
         dispatch_timeout=args.dispatch_timeout,
+        inference_dtype=args.inference_dtype,
+        quantize_matmuls=args.quantize_matmuls,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal or 'skip'),
         ccs_calibration_values=calibration_lib.parse_calibration_string(
@@ -569,6 +588,10 @@ def _dispatch(args) -> int:
 
       params = config_lib.get_config(args.config)
       config_lib.finalize_params(params, is_training=False)
+      # Checkpoint loads fold the levers in inside from_checkpoint;
+      # random-init weights get the same treatment here so --random_init
+      # serves exercise the identical quantized path.
+      runner_lib._apply_quant_levers(params, options)
       variables = model_lib.get_model(params).init(
           jax.random.PRNGKey(0),
           jnp.zeros((1, params.total_rows, params.max_length, 1)))
@@ -631,6 +654,8 @@ def _dispatch(args) -> int:
         emit_queue_depth=args.emit_queue_depth,
         on_device_error=args.on_device_error,
         dispatch_timeout=args.dispatch_timeout,
+        inference_dtype=args.inference_dtype,
+        quantize_matmuls=args.quantize_matmuls,
         pack_across_batches=not args.no_cross_batch_packing,
         max_record_bytes=args.max_record_bytes,
         dc_calibration_values=calibration_lib.parse_calibration_string(
@@ -744,6 +769,8 @@ def _dispatch(args) -> int:
         out_dir=args.output,
         batch_size=args.batch_size,
         strict_polymorphic=args.strict_polymorphic,
+        inference_dtype=args.inference_dtype,
+        quantize_matmuls=args.quantize_matmuls,
     )
     print(f'exported: {artifact}')
     return 0

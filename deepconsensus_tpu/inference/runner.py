@@ -130,6 +130,14 @@ class InferenceOptions:
   dispatch_timeout: float = 0.0
   # Resume an interrupted run from <output>.progress.json + <output>.tmp.
   resume: bool = False
+  # Quantized-inference levers (models/quantize.py), applied once at
+  # checkpoint load BEFORE device placement so sharded weight
+  # transfers ship the shrunken bytes. inference_dtype: None keeps the
+  # checkpoint's dtype; 'bfloat16' casts weights + runs activations
+  # bf16 end-to-end. quantize_matmuls: None/'none' off; 'int8'
+  # per-channel weight quantization of the encoder matmuls.
+  inference_dtype: Optional[str] = None
+  quantize_matmuls: Optional[str] = None
   # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
   end_after_stage: str = 'full'  # dc_input | tf_examples | run_model | full
   dc_calibration_values: calibration_lib.QualityCalibrationValues = (
@@ -192,6 +200,55 @@ def _bq_row_index(params) -> Optional[int]:
     return None
   bq_lo, _bq_hi = pileup.row_indices(params.max_passes, True)[5]
   return bq_lo
+
+
+def _apply_quant_levers(params, options: 'InferenceOptions') -> None:
+  """Fold the CLI quantization levers into a loaded params config.
+
+  inference_dtype also overrides the compute dtype so activations run
+  end-to-end in the requested precision; attn_softmax_dtype is left
+  alone (the independent f32 escape hatch). The actual weight
+  cast/quantization happens in ModelRunner.__init__ via
+  models/quantize.py, before any device placement.
+  """
+  with params.unlocked():
+    if options.inference_dtype:
+      params.inference_dtype = options.inference_dtype
+      params.dtype = options.inference_dtype
+    if options.quantize_matmuls and options.quantize_matmuls != 'none':
+      params.quantize_matmuls = options.quantize_matmuls
+
+
+def _check_exported_levers(meta, options: 'InferenceOptions',
+                           export_dir: str) -> None:
+  """Exported artifacts bake the quantization levers into the compiled
+  program; an explicitly requested lever that disagrees with the
+  artifact metadata is a serving mismatch, not a silent override."""
+  checks = (
+      ('inference_dtype', options.inference_dtype,
+       meta.get('inference_dtype') or 'float32', '--inference_dtype'),
+      ('quantize_matmuls', options.quantize_matmuls,
+       meta.get('quantize_matmuls') or 'none', '--quantize_matmuls'),
+  )
+  mismatches = [
+      (name, requested, baked, flag)
+      for name, requested, baked, flag in checks
+      if requested is not None and requested != baked
+  ]
+  if not mismatches:
+    return
+  detail = ', '.join(
+      f'{name}: artifact has {baked!r}, requested {requested!r}'
+      for name, requested, baked, _flag in mismatches)
+  flags = ' '.join(
+      f'{flag} {requested}' for _name, requested, _baked, flag in mismatches)
+  raise faults.ExportedArtifactMismatchError(
+      f'exported artifact quantization mismatch ({detail})',
+      reexport_command=(
+          f'dctpu export --checkpoint <orbax_ckpt> '
+          f'--output {export_dir} {flags}'
+      ),
+  )
 
 
 def _check_dp_divisible(options: 'InferenceOptions', mesh) -> int:
@@ -312,6 +369,15 @@ class ModelRunner:
   def __init__(self, params, variables, options: InferenceOptions,
                mesh=None):
     self.params = params
+    # Quantize/cast once on the host BEFORE any device placement, so
+    # the weight transfer below ships the shrunken bf16/int8 bytes
+    # (and degrade_mesh()'s re-placement keeps shipping them).
+    self._n_quantized_matmuls = 0
+    if variables:
+      from deepconsensus_tpu.models import quantize as quantize_lib
+
+      variables, self._n_quantized_matmuls = (
+          quantize_lib.prepare_inference_variables(variables, params))
     self.variables = variables
     self.options = options
     self.mesh = mesh
@@ -376,6 +442,13 @@ class ModelRunner:
     else:
       self._initial_dp = 0
     self._n_degraded = 0
+    # Quantization lever labels for /metricz and the run sidecar.
+    # from_exported builds the runner via cls.__new__ and never applies
+    # the levers itself (they are baked into the artifact), so default
+    # the counter here instead of in __init__.
+    self._n_quantized_matmuls = getattr(self, '_n_quantized_matmuls', 0)
+    self._inference_dtype_label = str(
+        self.params.get('inference_dtype', None) or 'float32')
 
   @staticmethod
   def _jit_forward(forward, mesh):
@@ -416,6 +489,7 @@ class ModelRunner:
 
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
+    _apply_quant_levers(params, options)
     return cls(params, {'params': load_params(checkpoint_path)}, options,
                mesh=mesh)
 
@@ -437,6 +511,7 @@ class ModelRunner:
     serving, meta = export_lib.load_exported(export_dir)
     params = config_lib.read_params_from_json(export_dir)
     config_lib.finalize_params(params, is_training=False)
+    _check_exported_levers(meta, options, export_dir)
     runner = cls.__new__(cls)
     runner.params = params
     runner.variables = None
@@ -619,6 +694,8 @@ class ModelRunner:
             if launches else 0.0),
         'n_mesh_degradations': self._n_degraded,
         'mesh_dp': self.mesh_dp,
+        'inference_dtype': self._inference_dtype_label,
+        'n_quantized_matmuls': self._n_quantized_matmuls,
     }
 
   @property
@@ -1727,7 +1804,14 @@ def run_inference(
   finally:
     if dead_letter is not None:
       dead_letter.close()
-    counter.update(window_counter)
+    # dispatch_stats() carries non-numeric labels (inference_dtype);
+    # Counter.update would try to add them to 0, so merge those by
+    # assignment and keep the numeric tally semantics for the rest.
+    for key, value in window_counter.items():
+      if isinstance(value, (int, float)):
+        counter[key] += value
+      else:
+        counter[key] = value
     if quarantine is not None:
       counter.update(quarantine.counters)
     # Sidecar outputs (reference: quick_inference.py:777-791,961-962),

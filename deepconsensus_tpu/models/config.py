@@ -275,6 +275,15 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   # (ops/fused_window_attention.py). Falls back to the XLA path for
   # training, init, non-condensed/non-ReZero configs, and long windows.
   params.use_fused_hotpath = False
+  # Quantized-inference levers (inference-only; training ignores both).
+  # inference_dtype: 'bfloat16' casts checkpoint weights once at load
+  # and runs activations end-to-end in bf16 (attn_softmax_dtype stays
+  # an independent f32 escape hatch). quantize_matmuls: 'int8' applies
+  # per-output-channel symmetric weight quantization to the encoder's
+  # attention-projection and FFN matmuls, with the dequant folded into
+  # the fused kernel epilogue (models/quantize.py).
+  params.inference_dtype = ml_collections.config_dict.placeholder(str)
+  params.quantize_matmuls = ml_collections.config_dict.placeholder(str)
   # Route AlignmentLoss through the whole-DP Pallas wavefront kernels
   # (forward scorer + custom-VJP backward) instead of the lax.scan DP.
   # Only applies when band_width is None (the training default).

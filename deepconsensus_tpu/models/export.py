@@ -32,6 +32,8 @@ def export_model(
     params: Optional[ml_collections.ConfigDict] = None,
     polymorphic_batch: bool = True,
     strict_polymorphic: bool = False,
+    inference_dtype: Optional[str] = None,
+    quantize_matmuls: Optional[str] = None,
 ) -> str:
   """Exports a serving function rows->softmax; returns artifact path.
 
@@ -53,12 +55,26 @@ def export_model(
   if params is None:
     params = config_lib.read_params_from_json(checkpoint_path)
     config_lib.finalize_params(params, is_training=False)
+  if inference_dtype or (quantize_matmuls and quantize_matmuls != 'none'):
+    with params.unlocked():
+      if inference_dtype:
+        params.inference_dtype = inference_dtype
+        params.dtype = inference_dtype
+      if quantize_matmuls and quantize_matmuls != 'none':
+        params.quantize_matmuls = quantize_matmuls
   model = model_lib.get_model(params)
 
   if variables is None:
     from deepconsensus_tpu.models.checkpoints import load_params
 
     variables = {'params': load_params(checkpoint_path)}
+  # Bake the quantization levers into the exported program: weights
+  # are cast/quantized before tracing, so the artifact carries the
+  # quantized-effective weights and the metadata below records which
+  # levers it was built with (from_exported refuses a mismatched load).
+  from deepconsensus_tpu.models import quantize as quantize_lib
+
+  variables, _ = quantize_lib.prepare_inference_variables(variables, params)
 
   def serving_fn(rows):
     return model.apply(variables, rows)
@@ -97,7 +113,11 @@ def export_model(
   config_lib.save_params_as_json(out_dir, params)
   with open(os.path.join(out_dir, 'export_meta.json'), 'w') as f:
     json.dump({'batch_size': batch_size, 'rows_shape': static_shape,
-               'polymorphic_batch': is_polymorphic}, f)
+               'polymorphic_batch': is_polymorphic,
+               'inference_dtype': params.get('inference_dtype', None)
+               or 'float32',
+               'quantize_matmuls': params.get('quantize_matmuls', None)
+               or 'none'}, f)
   return artifact
 
 

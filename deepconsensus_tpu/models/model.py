@@ -243,8 +243,18 @@ class EncoderStack(nn.Module):
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, deterministic: bool,
-               skip_first_attention: bool = False) -> jnp.ndarray:
+               skip_first_attention: bool = False,
+               skip_blocks: bool = False) -> jnp.ndarray:
     p = self.params
+
+    if skip_blocks:
+      # The fused hot path (ops/fused_encoder_block.py) already ran
+      # every attention/FFN block including the ReZero residuals; only
+      # the final normalization remains. Init never takes this branch,
+      # so the param tree is created identically.
+      return nn.LayerNorm(
+          epsilon=1e-6, dtype=jnp.float32, name='output_normalization'
+      )(x)
 
     # Optional rematerialization: drop each residual block's
     # activations and recompute them in the backward pass, trading
@@ -434,6 +444,8 @@ class DeepConsensusModel(nn.Module):
     wrap0 = params['encoder']['attention_wrapper_0']
     pos = None
     if p.add_pos_encoding:
+      # dclint: allow=dtype-downcast (position encodings enter the
+      # fused kernel at the configured compute dtype)
       pos = jnp.asarray(
           sinusoidal_position_encoding(rows.shape[-1], h),
           self.compute_dtype)
@@ -457,6 +469,34 @@ class DeepConsensusModel(nn.Module):
     alpha = wrap0['alpha']
     return x_base + alpha.astype(x_base.dtype) * attn_out
 
+  def _fused_encoder_blocks(self, x: jnp.ndarray) -> jnp.ndarray:
+    """Run every remaining encoder block (layer-0 FFN onward) through
+    the fused Pallas block kernel (ops/fused_encoder_block.py); the
+    caller finishes with the encoder's output LayerNorm
+    (skip_blocks=True). int8-quantized matmul weights ride in from the
+    'quant' collection when params.quantize_matmuls is set."""
+    from deepconsensus_tpu.ops import fused_encoder_block as feb
+
+    p = self.params
+    quant = None
+    if p.get('quantize_matmuls', None) == 'int8':
+      quant = self.variables.get('quant', {}).get('encoder')
+    blocks = feb.blocks_from_params(
+        self.variables['params']['encoder'],
+        quant,
+        p.num_hidden_layers,
+        skip_first_attention=True,
+    )
+    return feb.fused_encoder_stack(
+        x,
+        blocks,
+        num_heads=p.num_heads,
+        attn_win_size=p.attn_win_size or None,
+        softmax_dtype=jnp.dtype(p.get('attn_softmax_dtype', None)
+                                or 'float32'),
+        compute_dtype=self.compute_dtype,
+    )
+
   def __call__(
       self, rows: jnp.ndarray, train: bool = False
   ) -> jnp.ndarray:
@@ -472,8 +512,8 @@ class DeepConsensusModel(nn.Module):
       rows = jnp.squeeze(rows, -1)
     if self._fused_hotpath_eligible(rows, train):
       x = self._fused_forward(rows)
-      encoded = self.encoder(
-          x, deterministic=True, skip_first_attention=True)
+      x = self._fused_encoder_blocks(x)
+      encoded = self.encoder(x, deterministic=True, skip_blocks=True)
       logits = self.logits_layer(encoded.astype(jnp.float32))
       preds = jax.nn.softmax(logits, axis=-1)
       return {'final_output': encoded, 'logits': logits, 'preds': preds}
@@ -485,6 +525,8 @@ class DeepConsensusModel(nn.Module):
       # Raw per-position feature vectors [B, L, total_rows], zero-padded
       # to an even width for the positional encoding
       # (reference: networks.py:266-306).
+      # dclint: allow=dtype-downcast (model entry point: inputs adopt
+      # the configured compute dtype once, here)
       x = jnp.transpose(rows, (0, 2, 1)).astype(self.compute_dtype)
       if p.add_pos_encoding and x.shape[-1] % 2 != 0:
         x = jnp.pad(x, ((0, 0), (0, 0), (0, 1)))

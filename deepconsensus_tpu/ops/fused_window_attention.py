@@ -320,11 +320,14 @@ def fused_embed_condense_attention(
     ids = jnp.pad(ids, ((0, pad), (0, 0), (0, 0)))
   n_tiles = (b + pad) // tile
 
+  # dclint: allow=dtype-downcast (kernel inputs follow the configured
+  # compute dtype; bf16 here is the inference_dtype lever, not a leak)
   cast = lambda a: jnp.asarray(a, compute_dtype)
   # Fold the sqrt(width) embedding output scale into the tables
   # (MaskedEmbed multiplies after the lookup; the lookup is linear so
   # the fold is exact up to one f32 rounding).
   table_in = [
+      # dclint: allow=dtype-downcast (scale folded at compute dtype)
       cast(tables[key]) * jnp.asarray(
           next(s.width for s in specs if s.table_idx == i) ** 0.5,
           compute_dtype)

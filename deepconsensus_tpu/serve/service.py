@@ -567,6 +567,11 @@ class ConsensusService:
     counters.setdefault('n_device_faults', 0)
     counters.setdefault('n_dispatch_timeouts', 0)
     counters.setdefault('n_mesh_degradations', 0)
+    # Quantized-inference levers (--inference_dtype/--quantize_matmuls):
+    # the real values ride in from runner.dispatch_stats() through
+    # engine.stats() and replace these defaults below.
+    counters.setdefault('inference_dtype', 'float32')
+    counters.setdefault('n_quantized_matmuls', 0)
     with self._lock:
       outstanding = len(self._outstanding)
     out = {

@@ -20,6 +20,10 @@
 #                                  # --xla_force_host_platform_device_count=8,
 #                                  # so the default and fast tiers run
 #                                  # these too)
+#   ./run_all_tests.sh quant       # quantized-inference levers only:
+#                                  # bf16/int8 accuracy gates, fused
+#                                  # encoder-block parity, export
+#                                  # lever baking/mismatch
 #
 # Two-tier structure: the `slow` marker covers the heavy interpret-mode
 # Pallas golden sweeps (wavefront train/VJP/unroll, banded-attention
@@ -64,6 +68,10 @@ fi
 
 if [[ "${1:-}" == "multichip" ]]; then
   exec python -m pytest tests/ -q -m multichip
+fi
+
+if [[ "${1:-}" == "quant" ]]; then
+  exec python -m pytest tests/ -q -m quant
 fi
 
 # Static analysis first: dclint runs in under a second and fails fast

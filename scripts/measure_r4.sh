@@ -66,6 +66,31 @@ run_stage forward_fused_tile16 600 \
   env DC_TPU_FUSED_TILE=16 \
   python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
   --set use_fused_hotpath=true
+# Quantized-inference levers on the distilled student (round-10
+# beat-or-retire): f32/bf16/int8 through the full-encoder fused blocks
+# at the production L=100 and b1024. forward_student_f32 is the anchor
+# every lever stage reads against (same weights-shape model, same fused
+# routing — the lever is the only change); forward_fullfused is the
+# shipping configuration (bf16 activations + int8 matmuls). Decision
+# rule (docs/performance.md): a lever that does not beat the f32 fused
+# anchor on windows/s at equal accuracy gates is retired, not tuned.
+run_stage forward_student_f32 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --config transformer_learn_values_distill+test \
+  --set use_fused_hotpath=true
+run_stage forward_bf16 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --config transformer_learn_values_distill+test \
+  --set use_fused_hotpath=true --set inference_dtype=bfloat16
+run_stage forward_int8 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --config transformer_learn_values_distill+test \
+  --set use_fused_hotpath=true --set quantize_matmuls=int8
+run_stage forward_fullfused 600 \
+  python "$REPO/scripts/profile_forward.py" --batches 1024 --steps 10 \
+  --config transformer_learn_values_distill+test \
+  --set use_fused_hotpath=true --set inference_dtype=bfloat16 \
+  --set quantize_matmuls=int8
 # dp-sharded double-buffered dispatch (round-6 tentpole): real-chip dp
 # scaling of windows/s + transfer-overlap fraction. Staged to fire on
 # first live tunnel; until then the host-platform parity sweep lives

@@ -54,6 +54,10 @@ def main():
                   metavar='KEY=VALUE',
                   help='config override (e.g. embed_onehot=true, '
                   'attn_softmax_dtype=bfloat16) for lever A/Bs')
+  ap.add_argument('--config', default='transformer_learn_values+test',
+                  help='config preset; use '
+                  'transformer_learn_values_distill+test for the '
+                  'quantized-student sweeps')
   args = ap.parse_args()
 
   import jax
@@ -66,18 +70,37 @@ def main():
   from deepconsensus_tpu.models import model as model_lib
   from scripts._bench_common import make_rows
 
-  params = config_lib.get_config('transformer_learn_values+test')
+  params = config_lib.get_config(args.config)
   if args.overrides:
     from deepconsensus_tpu.cli import _apply_overrides
 
     _apply_overrides(params, args.overrides)
+  if params.get('inference_dtype', None):
+    # Mirror runner._apply_quant_levers: the inference dtype is also
+    # the compute dtype, so activations follow the weights end-to-end.
+    with params.unlocked():
+      params.dtype = params.inference_dtype
   config_lib.finalize_params(params, is_training=False)
   model = model_lib.get_model(params)
+  quant_levers = bool(
+      params.get('inference_dtype', None)
+      or (params.get('quantize_matmuls', None) or 'none') != 'none')
 
   for batch in args.batches:
     rows_np = make_rows(params, batch)
     rows = jnp.asarray(rows_np)
     variables = model.init(jax.random.PRNGKey(0), rows[:1])
+    n_quantized = 0
+    if quant_levers:
+      # Same transform the runner applies at load: int8-quantize the
+      # matmul weights (dequantized params + a 'quant' collection for
+      # the fused kernels), then cast float leaves to inference_dtype.
+      # Stage ablations below run the XLA methods on the transformed
+      # tree, so their numbers attribute the levered model.
+      from deepconsensus_tpu.models import quantize as quantize_lib
+
+      variables, n_quantized = quantize_lib.prepare_inference_variables(
+          variables, params)
     rows3 = jnp.squeeze(rows, -1)
 
     # -- cumulative ablations of the real model ------------------------
@@ -138,6 +161,12 @@ def main():
         },
         'n_layers': params.num_hidden_layers,
     }
+    if quant_levers:
+      result['inference_dtype'] = str(
+          params.get('inference_dtype', None) or 'float32')
+      result['quantize_matmuls'] = str(
+          params.get('quantize_matmuls', None) or 'none')
+      result['n_quantized_matmuls'] = n_quantized
     if flops_full:
       result['mfu'] = round(
           flops_full / t_full / PEAK_BF16_FLOPS, 4)

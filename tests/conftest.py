@@ -41,6 +41,12 @@ def pytest_configure(config):
       'multichip: data-parallel sharded-dispatch tests driven over '
       'the 8 forced host-platform devices (run_all_tests.sh multichip)',
   )
+  config.addinivalue_line(
+      'markers',
+      'quant: quantized-inference lever tests (bf16 end-to-end, int8 '
+      'matmuls) — accuracy gates and export plumbing '
+      '(run_all_tests.sh quant)',
+  )
 
 
 @pytest.fixture(scope='session')

@@ -295,6 +295,92 @@ class TestJitHazards:
 
 
 # ---------------------------------------------------------------------------
+# jit-hazards: dtype-downcast sub-rule
+# ---------------------------------------------------------------------------
+
+
+class TestDtypeDowncast:
+
+  MODELS = 'deepconsensus_tpu/models/fixture.py'
+  OPS = 'deepconsensus_tpu/ops/fixture.py'
+
+  def test_catches_astype_bfloat16(self):
+    found = findings_for(jit_hazards, self.MODELS, """\
+        import jax.numpy as jnp
+
+        def f(x):
+          return x.astype(jnp.bfloat16)
+        """)
+    assert len(found) == 1 and 'downcast' in found[0].message
+
+  def test_catches_asarray_string_dtype(self):
+    found = findings_for(jit_hazards, self.OPS, """\
+        import jax.numpy as jnp
+
+        def f(x):
+          return jnp.asarray(x, 'bfloat16')
+        """)
+    assert len(found) == 1
+
+  def test_catches_cast_to_compute_dtype(self):
+    found = findings_for(jit_hazards, self.MODELS, """\
+        class M:
+          def encode(self, x):
+            return x.astype(self.compute_dtype)
+        """)
+    assert len(found) == 1 and 'compute_dtype' in found[0].message
+
+  def test_catches_dtype_keyword_form(self):
+    found = findings_for(jit_hazards, self.OPS, """\
+        import jax.numpy as jnp
+
+        def f(x):
+          return jnp.array(x, dtype=jnp.float16)
+        """)
+    assert len(found) == 1
+
+  def test_passes_f32_upcast(self):
+    found = findings_for(jit_hazards, self.OPS, """\
+        import jax.numpy as jnp
+
+        def f(x):
+          return x.astype(jnp.float32)
+        """)
+    assert found == []
+
+  def test_passes_dtype_rematch(self):
+    """Casting to an existing array's dtype re-matches a decision made
+    elsewhere; the downcast site is wherever that dtype was chosen."""
+    found = findings_for(jit_hazards, self.OPS, """\
+        import jax.numpy as jnp
+
+        def kernel(x_ref, out_ref):
+          out_ref[...] = jnp.asarray(x_ref[...], out_ref.dtype)
+        """)
+    assert found == []
+
+  def test_allow_comment_suppresses(self):
+    found = findings_for(jit_hazards, self.MODELS, """\
+        import jax.numpy as jnp
+
+        def f(x):
+          # dclint: allow=dtype-downcast (model entry cast)
+          return x.astype(jnp.bfloat16)
+        """)
+    assert found == []
+
+  def test_out_of_scope_file_ignored(self):
+    found = findings_for(
+        jit_hazards, 'deepconsensus_tpu/io/fixture.py', """\
+        import jax.numpy as jnp
+
+        def f(x):
+          return x.astype(jnp.bfloat16)
+        """)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
 # guarded-by
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,368 @@
+"""Accuracy gates and plumbing tests for the quantized-inference
+levers (params.inference_dtype=bfloat16, params.quantize_matmuls=int8).
+
+The gates (satellite of the full-encoder fusion PR):
+
+* int8: held-out alignment_identity within 0.002 of the f32 baseline,
+  measured with models/evaluate.run_evaluation over synthetic labeled
+  TFRecords (and over the reference eval set where testdata exists).
+* bf16: end-to-end FASTQ parity vs f32 on synthetic ZMW BAMs with a
+  documented max-QV-delta gate.
+* export: both levers are baked into export_meta.json; a mismatched
+  from_exported load raises ExportedArtifactMismatchError naming the
+  exact re-export command (tested in both directions).
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.io import Example, TFRecordWriter, fastx
+from deepconsensus_tpu.models import (
+    config as config_lib,
+    evaluate as evaluate_lib,
+    export as export_lib,
+    model as model_lib,
+    quantize as quantize_lib,
+)
+from deepconsensus_tpu import faults as faults_lib
+
+pytestmark = pytest.mark.quant
+
+
+def _params(layers=2, **kw):
+  params = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(params, is_training=False)
+  with params.unlocked():
+    params.dtype = 'float32'
+    params.num_hidden_layers = layers
+    params.filter_size = 64
+    params.batch_size = 4
+    for k, v in kw.items():
+      params[k] = v
+  return params
+
+
+def _init_variables(params, seed=0):
+  model = model_lib.get_model(params)
+  rows = jnp.zeros((1, params.total_rows, params.max_length, 1))
+  return model.init(jax.random.PRNGKey(seed), rows)
+
+
+def write_labeled_tfrecord(path, params, n_examples=8, seed=5):
+  """Synthetic labeled tf.Examples in the reference layout
+  (subreads/encoded [total_rows, L, 1] + label/encoded [L]) so the
+  identity gate runs without the bundled reference testdata."""
+  rng = np.random.default_rng(seed)
+  h, length = params.total_rows, params.max_length
+  mp = params.max_passes
+  with TFRecordWriter(str(path)) as w:
+    for i in range(n_examples):
+      sub = np.zeros((h, length, 1), np.float32)
+      sub[:mp] = rng.integers(0, 5, size=sub[:mp].shape)
+      sub[mp:2 * mp] = rng.integers(0, 256, size=sub[:mp].shape)
+      sub[2 * mp:3 * mp] = rng.integers(0, 256, size=sub[:mp].shape)
+      sub[3 * mp:4 * mp] = rng.integers(0, 3, size=sub[:mp].shape)
+      sub[4 * mp] = rng.integers(0, 5, size=sub[4 * mp].shape)
+      sub[4 * mp + 1:] = rng.integers(0, 501, size=sub[4 * mp + 1:].shape)
+      label = rng.integers(0, 5, size=(length,)).astype(np.float32)
+      ex = Example()
+      ex.add_bytes('subreads/encoded', [sub.tobytes()])
+      ex.add_int64('subreads/shape', list(sub.shape))
+      ex.add_bytes('label/encoded', [label.tobytes()])
+      ex.add_int64('label/shape', [length])
+      ex.add_bytes('name', [f'm0/{i}/ccs'.encode()])
+      w.write(ex.serialize())
+  return str(path)
+
+
+# ---------------------------------------------------------------------------
+# Lever mechanics.
+# ---------------------------------------------------------------------------
+
+
+def test_prepare_variables_quantizes_and_dequantizes():
+  params = _params(quantize_matmuls='int8')
+  variables = _init_variables(params)
+  out, n_quantized = quantize_lib.prepare_inference_variables(
+      variables, params)
+  # 4 attention projections + 2 FFN matmuls per encoder layer.
+  assert n_quantized == 6 * params.num_hidden_layers
+  q = out['quant']['encoder']['ffn_0']['filter_layer']
+  assert q['values'].dtype == jnp.int8
+  assert q['scale'].dtype == jnp.float32
+  # The params leaf is REPLACED by the dequantized weight, so the XLA
+  # path and the accuracy gates see the quantized-effective model.
+  dequant = np.asarray(q['values'], np.float32) * np.asarray(q['scale'])
+  np.testing.assert_allclose(
+      np.asarray(out['params']['encoder']['ffn_0']['filter_layer']['kernel']),
+      dequant, rtol=1e-6)
+  # Round-trip error is bounded by half a quantization step per entry.
+  orig = np.asarray(
+      variables['params']['encoder']['ffn_0']['filter_layer']['kernel'])
+  step = np.asarray(q['scale'])[None, :]
+  assert np.all(np.abs(dequant - orig) <= 0.5 * step + 1e-7)
+
+
+def test_bf16_cast_applies_to_params_only():
+  params = _params(inference_dtype='bfloat16', quantize_matmuls='int8')
+  variables = _init_variables(params)
+  out, _ = quantize_lib.prepare_inference_variables(variables, params)
+  leaves = jax.tree_util.tree_leaves(out['params'])
+  assert all(l.dtype != jnp.float32 for l in leaves
+             if jnp.issubdtype(l.dtype, jnp.floating))
+  # int8 values and f32 scales are untouched by the bf16 cast.
+  q = out['quant']['encoder']['self_attention_0']['query']
+  assert q['values'].dtype == jnp.int8
+  assert q['scale'].dtype == jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Accuracy gates.
+# ---------------------------------------------------------------------------
+
+
+def test_int8_identity_within_gate_of_f32(tmp_path):
+  """The int8 acceptance gate: held-out alignment identity within
+  0.002 of the f32 baseline, via models/evaluate.run_evaluation."""
+  params = _params()
+  shard = write_labeled_tfrecord(
+      tmp_path / 'eval.tfrecord.gz', params)
+  variables = _init_variables(params)
+
+  base = evaluate_lib.run_evaluation(
+      params=params, checkpoint_path=None, eval_patterns=[shard],
+      out_dir=str(tmp_path / 'f32'), variables=variables)
+
+  params_q = _params(quantize_matmuls='int8')
+  variables_q, n_quantized = quantize_lib.prepare_inference_variables(
+      variables, params_q)
+  assert n_quantized > 0
+  quant = evaluate_lib.run_evaluation(
+      params=params_q, checkpoint_path=None, eval_patterns=[shard],
+      out_dir=str(tmp_path / 'int8'), variables=variables_q)
+
+  delta = abs(quant['alignment_identity'] - base['alignment_identity'])
+  assert delta <= 0.002, (
+      f'int8 identity gate failed: |delta|={delta:.5f} > 0.002 '
+      f'(f32={base["alignment_identity"]:.5f}, '
+      f'int8={quant["alignment_identity"]:.5f})')
+
+
+def test_int8_identity_gate_on_reference_eval_set(tmp_path, testdata_dir):
+  """Same 0.002 gate over the bundled reference eval examples (skips
+  where the reference testdata is not installed)."""
+  params = _params()
+  patterns = [str(testdata_dir / 'human_1m/tf_examples/eval/*')]
+  variables = _init_variables(params)
+  base = evaluate_lib.run_evaluation(
+      params=params, checkpoint_path=None, eval_patterns=patterns,
+      out_dir=str(tmp_path / 'f32'), variables=variables)
+  params_q = _params(quantize_matmuls='int8')
+  variables_q, _ = quantize_lib.prepare_inference_variables(
+      variables, params_q)
+  quant = evaluate_lib.run_evaluation(
+      params=params_q, checkpoint_path=None, eval_patterns=patterns,
+      out_dir=str(tmp_path / 'int8'), variables=variables_q)
+  assert abs(quant['alignment_identity']
+             - base['alignment_identity']) <= 0.002
+
+
+def test_bf16_fused_model_matches_f32():
+  """bf16 end-to-end: loose tolerance + near-total argmax agreement
+  (the same bar as the attn_softmax_dtype lever — bf16 legitimately
+  perturbs logits at ~1e-2)."""
+  params = _params()
+  variables = _init_variables(params, seed=2)
+  rng = np.random.default_rng(3)
+  rows = jnp.asarray(rng.integers(
+      0, 4, size=(4, params.total_rows, params.max_length, 1)
+  ).astype(np.float32))
+  ref = model_lib.get_model(params).apply(variables, rows)
+
+  params_bf16 = _params(inference_dtype='bfloat16', dtype='bfloat16',
+                        use_fused_hotpath=True)
+  variables_bf16, _ = quantize_lib.prepare_inference_variables(
+      variables, params_bf16)
+  got = model_lib.get_model(params_bf16).apply(variables_bf16, rows)
+  got = np.asarray(got, np.float32)
+  assert np.all(np.isfinite(got))
+  np.testing.assert_allclose(got, np.asarray(ref), atol=5e-2)
+  agree = np.mean(got.argmax(-1) == np.asarray(ref).argmax(-1))
+  assert agree >= 0.98, f'argmax agreement {agree:.3f}'
+
+
+# ---------------------------------------------------------------------------
+# End-to-end FASTQ: f32 vs bf16 on synthetic ZMW BAMs.
+# ---------------------------------------------------------------------------
+
+# Documented QV gate for the bf16 lever: per-base Phred QVs of reads
+# whose polished sequence matches the f32 run may move by at most this
+# many units (bf16 logit rounding is ~1e-2 relative; on the synthetic
+# BAMs the measured max delta is <=1, the gate leaves margin for other
+# inputs). Reads whose argmax flips at a near-tie are excluded from
+# the per-base comparison but bounded in count below.
+MAX_QV_DELTA = 3
+
+
+def test_fastq_f32_vs_bf16_qv_delta(tmp_path, synthetic_bams):
+  subreads, ccs = synthetic_bams()
+  params = _params()
+  variables = _init_variables(params, seed=4)
+
+  def run(tag, inference_dtype):
+    options = runner_lib.InferenceOptions(
+        batch_size=32, batch_zmws=4, min_quality=0,
+        inference_dtype=inference_dtype)
+    p = _params()
+    runner_lib._apply_quant_levers(p, options)
+    runner = runner_lib.ModelRunner(p, variables, options)
+    out = str(tmp_path / f'{tag}.fastq')
+    counters = runner_lib.run_inference(
+        subreads_to_ccs=subreads, ccs_bam=ccs, checkpoint=None,
+        output=out, options=options, runner=runner)
+    return counters, {name: (seq, qual)
+                      for name, seq, qual in fastx.read_fastq(out)}
+
+  counters32, reads32 = run('f32', None)
+  counters16, reads16 = run('bf16', 'bfloat16')
+
+  # The non-numeric inference_dtype label must survive the counter
+  # merge (plain Counter.update would TypeError on strings).
+  assert counters32['inference_dtype'] == 'float32'
+  assert counters16['inference_dtype'] == 'bfloat16'
+  assert counters16['n_zmw_pass'] == counters32['n_zmw_pass'] > 0
+
+  assert set(reads16) == set(reads32)
+  same_seq = [n for n in reads32 if reads16[n][0] == reads32[n][0]]
+  # bf16 near-tie argmax flips may change a few bases; most reads must
+  # polish to the identical sequence.
+  assert len(same_seq) * 2 >= len(reads32), (
+      f'only {len(same_seq)}/{len(reads32)} reads match between f32 '
+      'and bf16')
+  max_delta = 0
+  for name in same_seq:
+    q32 = np.frombuffer(reads32[name][1].encode(), np.uint8)
+    q16 = np.frombuffer(reads16[name][1].encode(), np.uint8)
+    max_delta = max(max_delta, int(np.abs(
+        q32.astype(int) - q16.astype(int)).max()))
+  assert max_delta <= MAX_QV_DELTA, (
+      f'bf16 QV gate failed: max per-base delta {max_delta} > '
+      f'{MAX_QV_DELTA}')
+
+
+def test_runner_dispatch_stats_reports_levers():
+  params = _params()
+  variables = _init_variables(params)
+  options = runner_lib.InferenceOptions(
+      batch_size=32, inference_dtype='bfloat16', quantize_matmuls='int8')
+  p = _params()
+  runner_lib._apply_quant_levers(p, options)
+  runner = runner_lib.ModelRunner(p, variables, options)
+  stats = runner.dispatch_stats()
+  assert stats['inference_dtype'] == 'bfloat16'
+  assert stats['n_quantized_matmuls'] == 6 * params.num_hidden_layers
+
+  # Levers off: explicit f32 label, zero quantized matmuls.
+  plain = runner_lib.ModelRunner(
+      _params(), variables, runner_lib.InferenceOptions(batch_size=32))
+  stats = plain.dispatch_stats()
+  assert stats['inference_dtype'] == 'float32'
+  assert stats['n_quantized_matmuls'] == 0
+
+
+def test_bf16_int8_runner_predict_agrees_with_f32():
+  params = _params()
+  variables = _init_variables(params, seed=6)
+  rng = np.random.default_rng(7)
+  rows = rng.integers(
+      0, 4, size=(8, params.total_rows, params.max_length, 1)
+  ).astype(np.float32)
+
+  base = runner_lib.ModelRunner(
+      _params(), variables, runner_lib.InferenceOptions(batch_size=8))
+  ids_b, q_b = base.predict(rows)
+
+  options = runner_lib.InferenceOptions(
+      batch_size=8, inference_dtype='bfloat16', quantize_matmuls='int8')
+  p = _params(use_fused_hotpath=True)
+  runner_lib._apply_quant_levers(p, options)
+  quant = runner_lib.ModelRunner(p, variables, options)
+  ids_q, q_q = quant.predict(rows)
+
+  assert np.all(np.isfinite(np.asarray(q_q, np.float32)))
+  agree = np.mean(np.asarray(ids_q) == np.asarray(ids_b))
+  assert agree >= 0.95, f'base agreement {agree:.3f}'
+
+
+# ---------------------------------------------------------------------------
+# Exported artifacts: levers baked into metadata, mismatch refused.
+# ---------------------------------------------------------------------------
+
+
+def _export(tmp_path, tag, **levers):
+  params = _params(layers=1)
+  variables = _init_variables(params)
+  export_dir = str(tmp_path / tag)
+  export_lib.export_model(
+      checkpoint_path=export_dir, out_dir=export_dir, batch_size=8,
+      variables=variables, params=params, **levers)
+  return export_dir
+
+
+def test_export_bakes_levers_into_metadata(tmp_path):
+  export_dir = _export(tmp_path, 'quant', inference_dtype='bfloat16',
+                       quantize_matmuls='int8')
+  with open(f'{export_dir}/export_meta.json') as f:
+    meta = json.load(f)
+  assert meta['inference_dtype'] == 'bfloat16'
+  assert meta['quantize_matmuls'] == 'int8'
+  # No levers requested -> explicit defaults recorded.
+  plain_dir = _export(tmp_path, 'plain')
+  with open(f'{plain_dir}/export_meta.json') as f:
+    meta = json.load(f)
+  assert meta['inference_dtype'] == 'float32'
+  assert meta['quantize_matmuls'] == 'none'
+
+
+def test_exported_lever_mismatch_raises_both_directions(tmp_path):
+  quant_dir = _export(tmp_path, 'quant', inference_dtype='bfloat16',
+                      quantize_matmuls='int8')
+  plain_dir = _export(tmp_path, 'plain')
+
+  # Baked bf16/int8, caller explicitly demands f32: refused, and the
+  # fault names the exact re-export command.
+  with pytest.raises(faults_lib.ExportedArtifactMismatchError) as excinfo:
+    runner_lib.ModelRunner.from_exported(
+        quant_dir,
+        runner_lib.InferenceOptions(batch_size=8,
+                                    inference_dtype='float32'))
+  err = excinfo.value
+  assert err.reexport_command and 'dctpu export' in err.reexport_command
+  assert '--inference_dtype float32' in err.reexport_command
+  assert err.reexport_command in str(err)
+
+  # Baked plain, caller explicitly demands int8: also refused.
+  with pytest.raises(faults_lib.ExportedArtifactMismatchError) as excinfo:
+    runner_lib.ModelRunner.from_exported(
+        plain_dir,
+        runner_lib.InferenceOptions(batch_size=8, quantize_matmuls='int8'))
+  assert '--quantize_matmuls int8' in excinfo.value.reexport_command
+
+
+def test_exported_lever_match_and_none_accepted(tmp_path):
+  export_dir = _export(tmp_path, 'quant', inference_dtype='bfloat16',
+                       quantize_matmuls='int8')
+  # Explicitly matching levers load fine.
+  runner_lib.ModelRunner.from_exported(
+      export_dir,
+      runner_lib.InferenceOptions(batch_size=8, inference_dtype='bfloat16',
+                                  quantize_matmuls='int8'))
+  # No preference (None) accepts the artifact as-is — flag-less loads
+  # of quantized artifacts keep working.
+  runner = runner_lib.ModelRunner.from_exported(
+      export_dir, runner_lib.InferenceOptions(batch_size=8))
+  assert runner.dispatch_stats()['inference_dtype'] == 'bfloat16'

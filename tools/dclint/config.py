@@ -142,6 +142,29 @@ HOST_SYNC_CALLS = frozenset({'float', 'int', 'bool', 'asarray', 'array'})
 # transfer/compute overlap (jit-hazards double-buffer rule).
 FORWARD_CALLS = frozenset({'_forward'})
 
+# dtype-downcast sub-rule: modules where an unannotated cast to a
+# reduced-precision dtype is flagged.  With bf16 inference live, a
+# stray `astype(jnp.bfloat16)` (or a cast through the compute-dtype
+# knobs) in model/kernel code silently halves the mantissa of a value
+# the author may have assumed stayed f32; every deliberate downcast
+# carries `# dclint: allow=dtype-downcast (reason)`.
+DTYPE_DOWNCAST_SCOPE = (
+    'deepconsensus_tpu/models/',
+    'deepconsensus_tpu/ops/',
+)
+
+# Literal / attribute dtype targets that are reduced-precision.
+HALF_DTYPES = frozenset({'bfloat16', 'float16'})
+
+# Config-driven dtype names: casting to these is a downcast whenever
+# the inference_dtype lever is bf16, so the cast site must be
+# deliberate and annotated.
+COMPUTE_DTYPE_NAMES = frozenset({'compute_dtype', 'inference_dtype'})
+
+# Cast-shaped calls (last dotted segment) the dtype-downcast rule
+# inspects: `x.astype(d)` and `jnp.asarray(x, d)` / `jnp.array(x, d)`.
+DTYPE_CAST_CALLS = frozenset({'astype', 'asarray', 'array'})
+
 # ---------------------------------------------------------------------------
 # guarded-by
 # ---------------------------------------------------------------------------

@@ -16,6 +16,15 @@ hot functions), flag:
 
 A deliberate sync (there is exactly one, in ``ModelRunner.finalize``)
 carries ``# dclint: allow=jit-hazards (reason)``.
+
+A separate dtype-downcast sub-rule covers ``config.DTYPE_DOWNCAST_SCOPE``
+(models/ and ops/): any ``astype`` / ``asarray`` / ``array`` call whose
+dtype target is a reduced-precision literal (``bfloat16`` /
+``float16``) or one of the compute-dtype knobs (``compute_dtype`` /
+``inference_dtype``) must carry
+``# dclint: allow=dtype-downcast (reason)`` — with bf16 inference
+live, an unannotated downcast silently halves the mantissa of a value
+the author may have assumed stayed f32.
 """
 
 from __future__ import annotations
@@ -260,13 +269,66 @@ def _double_buffer_findings(src: core.SourceFile,
   return out
 
 
+def _dtype_target(node: ast.Call) -> Optional[ast.AST]:
+  """The dtype expression of a cast-shaped call, if any.
+
+  `x.astype(d)` -> d; `jnp.asarray(x, d)` / `jnp.array(x, d)` -> d
+  (positionally or via the `dtype=` keyword).
+  """
+  seg = core.last_segment(node.func)
+  if seg not in config.DTYPE_CAST_CALLS:
+    return None
+  for kw in node.keywords:
+    if kw.arg == 'dtype':
+      return kw.value
+  if seg == 'astype':
+    return node.args[0] if node.args else None
+  return node.args[1] if len(node.args) > 1 else None
+
+
+def _is_downcast_target(expr: ast.AST) -> bool:
+  if isinstance(expr, ast.Constant):
+    return expr.value in config.HALF_DTYPES
+  seg = core.last_segment(expr)
+  # `astype(x.dtype)` / `astype(out_ref.dtype)` re-matches an existing
+  # array's dtype and is not a downcast decision at this site.
+  return seg in config.HALF_DTYPES or seg in config.COMPUTE_DTYPE_NAMES
+
+
+def _dtype_downcast_findings(src: core.SourceFile) -> List[core.Finding]:
+  out = []
+  for node in ast.walk(src.tree):
+    if not isinstance(node, ast.Call):
+      continue
+    target = _dtype_target(node)
+    if target is None or not _is_downcast_target(target):
+      continue
+    if src.allowed('dtype-downcast', node.lineno):
+      continue
+    if isinstance(target, ast.Constant):
+      label = repr(target.value)
+    else:
+      label = core.dotted_name(target) or '<dtype>'
+    out.append(core.Finding(
+        RULE, src.path, node.lineno,
+        f'`{core.dotted_name(node.func)}(...)` casts to `{label}` — a '
+        'reduced-precision downcast in model/kernel code; if '
+        'deliberate, annotate the site with '
+        '`# dclint: allow=dtype-downcast (reason)`'))
+  return out
+
+
 def check(src: core.SourceFile) -> List[core.Finding]:
+  out: List[core.Finding] = []
+  if core.in_scope(src.path, config.DTYPE_DOWNCAST_SCOPE):
+    core.add_parents(src.tree)
+    out += _dtype_downcast_findings(src)
   if not core.in_scope(src.path, config.JIT_SCOPE):
-    return []
+    return out
   core.add_parents(src.tree)
   hot = set(config.HOT_FUNCTIONS.get(src.path, frozenset()))
   handles = _jit_handles(src.tree)
-  return (_construction_findings(src, hot)
-          + _scalar_arg_findings(src, handles)
-          + _host_sync_findings(src, hot, handles)
-          + _double_buffer_findings(src, hot))
+  return out + (_construction_findings(src, hot)
+                + _scalar_arg_findings(src, handles)
+                + _host_sync_findings(src, hot, handles)
+                + _double_buffer_findings(src, hot))
