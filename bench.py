@@ -328,6 +328,85 @@ def _e2e_stage(details, repeats=3):
   return e2e_line
 
 
+def _d2h_bytes_stage(details, budget_left, batch=1024, n_iters=3):
+  """Device-epilogue A/B on the distilled student at b1024: measured
+  D2H bytes/pack (the finalize drain records the actual device-array
+  bytes it pulled) and windows/s with the output plane on device vs on
+  host. The bytes ratio is backend-independent — uint8 (ids, quals)
+  vs int32 ids + f32 max_prob is 2 vs 8 bytes/position however the
+  forward ran — so the stage also runs in CPU-fallback captures; the
+  windows/s A/B only means something on real hardware (measure_r4.sh
+  stages it as forward_epilogue)."""
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from deepconsensus_tpu.inference import runner as runner_lib
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  try:
+    sp = config_lib.get_config('transformer_learn_values_distill+test')
+    config_lib.finalize_params(sp, is_training=False)
+    rows = _make_rows(sp, batch, seed=9).astype(np.float32)
+    variables = model_lib.get_model(sp).init(
+        jax.random.PRNGKey(0), jnp.asarray(rows[:1]))
+  except Exception as e:
+    details['stages']['d2h_bytes'] = {'error': repr(e)[:200]}
+    _write_details(details)
+    return
+  stage = {
+      'model': 'transformer_learn_values_distill',
+      'batch': batch,
+      'variants': {},
+  }
+  outputs = {}
+  for name, device_epilogue in (('epilogue_on', True),
+                                ('epilogue_off', False)):
+    if budget_left() < 60:
+      stage['variants'][name] = {'error': 'skipped: bench budget exhausted'}
+      continue
+    try:
+      options = runner_lib.InferenceOptions(
+          batch_size=batch, device_epilogue=device_epilogue,
+          max_passes=sp.max_passes, max_length=sp.max_length,
+          use_ccs_bq=sp.use_ccs_bq)
+      runner = runner_lib.ModelRunner(sp, dict(variables), options,
+                                      mesh=None)
+      outputs[name] = runner.predict(rows)  # compile + warmup
+      t0 = time.perf_counter()
+      for _ in range(n_iters):
+        outputs[name] = runner.predict(rows)
+      dt = time.perf_counter() - t0
+      stats = runner.dispatch_stats()
+      stage['variants'][name] = {
+          'windows_per_sec': round(batch * n_iters / dt, 1),
+          'd2h_bytes_per_pack': stats['d2h_bytes_per_pack'],
+          'd2h_bytes_per_position': round(
+              stats['d2h_bytes_per_pack'] / (batch * sp.max_length), 2),
+          'n_epilogue_packs': stats['n_epilogue_packs'],
+          'host_load': _host_load(),
+      }
+    except Exception as e:
+      stage['variants'][name] = {'error': repr(e)[:200]}
+  on = stage['variants'].get('epilogue_on', {})
+  off = stage['variants'].get('epilogue_off', {})
+  if on.get('d2h_bytes_per_pack') and off.get('d2h_bytes_per_pack'):
+    stage['d2h_reduction'] = round(
+        off['d2h_bytes_per_pack'] / on['d2h_bytes_per_pack'], 2)
+    stage['speedup_epilogue'] = round(
+        on['windows_per_sec'] / off['windows_per_sec'], 3)
+  if 'epilogue_on' in outputs and 'epilogue_off' in outputs:
+    stage['byte_identical'] = bool(
+        np.array_equal(np.asarray(outputs['epilogue_on'][0], np.int64),
+                       np.asarray(outputs['epilogue_off'][0], np.int64))
+        and np.array_equal(
+            np.asarray(outputs['epilogue_on'][1], np.int64),
+            np.asarray(outputs['epilogue_off'][1], np.int64)))
+  details['stages']['d2h_bytes'] = stage
+  _write_details(details)
+
+
 def main():
   # CPU-fallback mode: the parent sets DC_BENCH_CPU=1 when every TPU
   # probe fails, so the round still records an honest (slow) number
@@ -401,6 +480,10 @@ def main():
     # Accelerator-independent like featurize: the dp children force
     # their own 8 virtual CPU devices regardless of this child's mode.
     _dp_scaling_stage(details, budget_left)
+    # The bytes/pack ratio is backend-independent (CPU proof of the
+    # 4x D2H reduction); the windows/s A/B defers to real hardware.
+    if budget_left() > 90:
+      _d2h_bytes_stage(details, budget_left)
     return
 
   # Stage 2: forward throughput at the production batch size.
@@ -503,6 +586,12 @@ def main():
   # rather than trusting the capture-start sample.
   if budget_left() > 150:
     _quant_forward_stage(details, budget_left)
+
+  # Stage 5d: device-epilogue D2H A/B on the distilled student
+  # (round-11): measured bytes/pack + windows/s with the output plane
+  # on device vs on host.
+  if budget_left() > 120:
+    _d2h_bytes_stage(details, budget_left)
 
   # Stage 6: training throughput (full train step, batch 256), scan DP
   # vs Pallas wavefront-VJP loss. Opportunistic: the train-step compile

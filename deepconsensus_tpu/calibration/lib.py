@@ -34,6 +34,15 @@ def parse_calibration_string(calibration: str) -> QualityCalibrationValues:
   )
 
 
+def calibration_string(values: QualityCalibrationValues) -> str:
+  """Inverse of parse_calibration_string: a CLI-pasteable string, used
+  by error messages that tell the operator the exact flag to re-run
+  (e.g. exported-artifact epilogue mismatches)."""
+  if not values.enabled:
+    return 'skip'
+  return f'{values.threshold:g},{values.w:g},{values.b:g}'
+
+
 def calibrate_quality_scores(
     quality_scores: np.ndarray,
     calibration_values: QualityCalibrationValues,

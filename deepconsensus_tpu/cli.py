@@ -145,8 +145,26 @@ def _add_run(sub):
                  'a record claiming more than this many bytes is '
                  'treated as corrupt (quarantined under '
                  '--on_zmw_error=skip) instead of allocated.')
+  _add_epilogue_flag(p)
   _add_quant_flags(p)
   _add_device_fault_flags(p)
+
+
+def _add_epilogue_flag(p):
+  # Tri-state (None/auto by default): an explicit choice is enforced
+  # against exported-artifact metadata, auto follows it.
+  g = p.add_mutually_exclusive_group()
+  g.add_argument('--device_epilogue', dest='device_epilogue',
+                 action='store_true', default=None,
+                 help='Device-resident output plane: compute argmax + '
+                 'Phred quality (threshold table, byte-identical to '
+                 'the host math) on device and drain uint8 planes — 2 '
+                 'bytes/position D2H instead of 8. Default: on for '
+                 'checkpoints, follow-the-artifact for exported runs.')
+  g.add_argument('--no_device_epilogue', dest='device_epilogue',
+                 action='store_false',
+                 help='Force the host quality path (ship int32 ids + '
+                 'f32 max_prob and do the Phred math on the host).')
 
 
 def _add_quant_flags(p):
@@ -241,6 +259,7 @@ def _add_serve(sub):
                  help='Tensor-parallel devices per replica (model-axis '
                  'sharded attention/FFN weights); exported artifacts '
                  'require tp=1.')
+  _add_epilogue_flag(p)
   _add_quant_flags(p)
   _add_device_fault_flags(p)
 
@@ -361,6 +380,23 @@ def _add_export(sub):
   p.add_argument('--strict_polymorphic', action='store_true',
                  help='Fail instead of falling back to a fixed-batch '
                  'artifact when batch-polymorphic export fails.')
+  p.add_argument('--device_epilogue', dest='device_epilogue',
+                 action='store_true', default=True,
+                 help='Bake the device output plane into the artifact: '
+                 'the serving call returns final uint8 (ids, quals) '
+                 'planes with the calibration/clamp below compiled in '
+                 '(default).')
+  p.add_argument('--no_device_epilogue', dest='device_epilogue',
+                 action='store_false',
+                 help='Export a pre-epilogue artifact that returns '
+                 'softmax preds (host computes qualities).')
+  p.add_argument('--max_base_quality', type=int, default=93,
+                 help='Quality clamp baked into the device epilogue '
+                 '(must match serving; recorded in the metadata).')
+  p.add_argument('--dc_calibration', default=None,
+                 help='Calibration string baked into the device '
+                 'epilogue; default reads dc_calibration from the '
+                 'checkpoint params.json (like dctpu run).')
   _add_quant_flags(p)
 
 
@@ -565,6 +601,7 @@ def _dispatch(args) -> int:
         dispatch_timeout=args.dispatch_timeout,
         inference_dtype=args.inference_dtype,
         quantize_matmuls=args.quantize_matmuls,
+        device_epilogue=args.device_epilogue,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal or 'skip'),
         ccs_calibration_values=calibration_lib.parse_calibration_string(
@@ -656,6 +693,7 @@ def _dispatch(args) -> int:
         dispatch_timeout=args.dispatch_timeout,
         inference_dtype=args.inference_dtype,
         quantize_matmuls=args.quantize_matmuls,
+        device_epilogue=args.device_epilogue,
         pack_across_batches=not args.no_cross_batch_packing,
         max_record_bytes=args.max_record_bytes,
         dc_calibration_values=calibration_lib.parse_calibration_string(
@@ -762,8 +800,13 @@ def _dispatch(args) -> int:
     return 0
 
   if args.command == 'export':
+    from deepconsensus_tpu.models import config as config_lib
     from deepconsensus_tpu.models import export as export_lib
 
+    dc_cal = args.dc_calibration
+    if dc_cal is None:
+      params = config_lib.read_params_from_json(args.checkpoint)
+      dc_cal = params.get('dc_calibration', 'skip') or 'skip'
     artifact = export_lib.export_model(
         checkpoint_path=args.checkpoint,
         out_dir=args.output,
@@ -771,6 +814,9 @@ def _dispatch(args) -> int:
         strict_polymorphic=args.strict_polymorphic,
         inference_dtype=args.inference_dtype,
         quantize_matmuls=args.quantize_matmuls,
+        device_epilogue=args.device_epilogue,
+        max_base_quality=args.max_base_quality,
+        dc_calibration=dc_cal,
     )
     print(f'exported: {artifact}')
     return 0

@@ -46,6 +46,7 @@ from deepconsensus_tpu.io import bam as bam_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import data as data_lib
 from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.ops import output_plane
 from deepconsensus_tpu.postprocess import stitch
 from deepconsensus_tpu.preprocess import (
     FeatureLayout,
@@ -138,6 +139,17 @@ class InferenceOptions:
   # per-channel weight quantization of the encoder matmuls.
   inference_dtype: Optional[str] = None
   quantize_matmuls: Optional[str] = None
+  # Device-resident output plane (ops/output_plane.py): the forward
+  # emits the final uint8 (base ids, Phred quality) planes on device —
+  # argmax plus a threshold-table quality byte-identical to the host
+  # epilogue — so finalize becomes a pure 2-bytes/position drain (vs 8
+  # for int32 ids + f32 max_prob). Tri-state: None (auto) turns it on
+  # for checkpoints and follows the artifact metadata for exported
+  # runs; an explicit True/False is enforced — disagreeing with an
+  # exported artifact raises ExportedArtifactMismatchError. Falls back
+  # to the host path (with a warning) when the calibration is not
+  # device-representable (non-monotone, or top quality past uint8).
+  device_epilogue: Optional[bool] = None
   # Debug stage truncation (reference DebugStage: quick_inference.py:68-75).
   end_after_stage: str = 'full'  # dc_input | tf_examples | run_model | full
   dc_calibration_values: calibration_lib.QualityCalibrationValues = (
@@ -249,6 +261,55 @@ def _check_exported_levers(meta, options: 'InferenceOptions',
           f'--output {export_dir} {flags}'
       ),
   )
+
+
+def _check_exported_epilogue(meta, options: 'InferenceOptions',
+                             export_dir: str) -> None:
+  """The output plane is compiled into exported artifacts: an epilogue
+  artifact always emits uint8 (ids, quals) with its baked calibration
+  and clamp, a pre-epilogue artifact can only feed the host quality
+  path. An explicit --device_epilogue/--no_device_epilogue — or a
+  quality knob disagreeing with what an epilogue artifact baked — is a
+  serving mismatch, not a silent override (same contract as
+  _check_exported_levers)."""
+  baked = bool(meta.get('device_epilogue'))
+  requested = options.device_epilogue
+  if requested is not None and requested != baked:
+    flag = '--device_epilogue' if requested else '--no_device_epilogue'
+    raise faults.ExportedArtifactMismatchError(
+        f'exported artifact output-plane mismatch (artifact has '
+        f'device_epilogue={baked}, requested {flag})',
+        reexport_command=(
+            f'dctpu export --checkpoint <orbax_ckpt> '
+            f'--output {export_dir} {flag}'
+        ),
+    )
+  if not baked:
+    return
+  baked_maxq = int(meta.get('max_base_quality', 93))
+  if int(options.max_base_quality) != baked_maxq:
+    raise faults.ExportedArtifactMismatchError(
+        f'exported artifact bakes max_base_quality={baked_maxq} into '
+        f'its device epilogue, requested {options.max_base_quality}',
+        reexport_command=(
+            f'dctpu export --checkpoint <orbax_ckpt> '
+            f'--output {export_dir} '
+            f'--max_base_quality {options.max_base_quality}'
+        ),
+    )
+  baked_cal_str = meta.get('dc_calibration') or 'skip'
+  baked_cal = calibration_lib.parse_calibration_string(baked_cal_str)
+  if options.dc_calibration_values != baked_cal:
+    requested_cal = calibration_lib.calibration_string(
+        options.dc_calibration_values)
+    raise faults.ExportedArtifactMismatchError(
+        f'exported artifact bakes dc-calibration {baked_cal_str!r} '
+        f'into its device epilogue, requested {requested_cal!r}',
+        reexport_command=(
+            f'dctpu export --checkpoint <orbax_ckpt> '
+            f'--output {export_dir} --dc_calibration {requested_cal}'
+        ),
+    )
 
 
 def _check_dp_divisible(options: 'InferenceOptions', mesh) -> int:
@@ -402,10 +463,23 @@ class ModelRunner:
     model = model_lib.get_model(params)
     self._bq_row = _bq_row_index(params)
     bq_row = self._bq_row
+    self._configure_epilogue()
+    thresholds = self._epilogue_thresholds
+    # The Pallas epilogue rides the fused hot path (appended after the
+    # last fused encoder block's output); under a mesh the XLA epilogue
+    # shards trivially with the existing out_shardings instead.
+    pallas_epilogue = (
+        thresholds is not None
+        and bool(params.get('use_fused_hotpath', False))
+        and mesh is None
+    )
 
     def forward(variables, main_u8, sn):
       rows = _assemble_rows(main_u8, sn, bq_row)
       preds = model.apply(variables, rows)
+      if thresholds is not None:
+        return output_plane.phred_epilogue(
+            preds, thresholds, use_pallas=pallas_epilogue)
       pred_ids = jnp.argmax(preds, axis=-1).astype(jnp.int32)
       max_prob = jnp.max(preds, axis=-1)
       return pred_ids, max_prob
@@ -415,6 +489,32 @@ class ModelRunner:
     self._make_forward = lambda m: self._jit_forward(forward, m)
     self._forward = self._make_forward(mesh)
     self._init_dispatch_state(mesh)
+
+  def _configure_epilogue(self) -> None:
+    """Resolves the tri-state device_epilogue option against the
+    quality knobs: builds the exact threshold table
+    (ops/output_plane.py) when the device output plane is on, or
+    records the host fallback — warning when the operator asked for
+    the device path but the prob->quality map is not
+    device-representable."""
+    opts = self.options
+    want = opts.device_epilogue
+    if want is None:
+      want = True  # default on for checkpoint-loaded runners
+    self._device_epilogue = False
+    self._epilogue_thresholds = None
+    if not want:
+      return
+    thresholds = output_plane.quality_thresholds(
+        opts.dc_calibration_values, opts.max_base_quality)
+    if thresholds is None:
+      log.warning(
+          'device epilogue unavailable for this dc-calibration/'
+          'max_base_quality (non-monotone calibration, or top quality '
+          'past the uint8 plane); falling back to host quality math')
+      return
+    self._device_epilogue = True
+    self._epilogue_thresholds = thresholds
 
   def _init_dispatch_state(self, mesh) -> None:
     """Dispatch-contract state shared by __init__ and from_exported
@@ -449,6 +549,17 @@ class ModelRunner:
     self._n_quantized_matmuls = getattr(self, '_n_quantized_matmuls', 0)
     self._inference_dtype_label = str(
         self.params.get('inference_dtype', None) or 'float32')
+    # Output-plane state: checkpoint __init__ resolves it in
+    # _configure_epilogue before reaching here; from_exported sets it
+    # from the artifact metadata (the epilogue is compiled in, no
+    # threshold table needed host-side). Same getattr pattern as
+    # _n_quantized_matmuls.
+    self._device_epilogue = getattr(self, '_device_epilogue', False)
+    self._epilogue_thresholds = getattr(self, '_epilogue_thresholds', None)
+    self._n_epilogue_packs = 0
+    # Measured at the first finalize drain (actual device-array bytes
+    # pulled host-side per pack), for /metricz and the bench A/B.
+    self._d2h_bytes_per_pack = 0
 
   @staticmethod
   def _jit_forward(forward, mesh):
@@ -512,9 +623,16 @@ class ModelRunner:
     params = config_lib.read_params_from_json(export_dir)
     config_lib.finalize_params(params, is_training=False)
     _check_exported_levers(meta, options, export_dir)
+    _check_exported_epilogue(meta, options, export_dir)
+    baked_epilogue = bool(meta.get('device_epilogue'))
     runner = cls.__new__(cls)
     runner.params = params
     runner.variables = None
+    # The output plane is part of the compiled program: when baked, the
+    # serving call already returns the uint8 (ids, quals) planes and
+    # finalize is a pure drain; no host-side threshold table exists.
+    runner._device_epilogue = baked_epilogue
+    runner._epilogue_thresholds = None
     if not meta.get('polymorphic_batch'):
       # Fixed-batch artifact: the compiled shape wins over the flag.
       if mesh is not None:
@@ -534,7 +652,12 @@ class ModelRunner:
     bq_row = runner._bq_row
 
     def apply_serving(main_u8, sn):
-      preds = serving(_assemble_rows(main_u8, sn, bq_row))
+      out = serving(_assemble_rows(main_u8, sn, bq_row))
+      if baked_epilogue:
+        # Epilogue artifact: `out` already is the uint8 (ids, quals)
+        # tuple — the whole output plane ran inside the baked program.
+        return tuple(out)
+      preds = out
       return (
           jnp.argmax(preds, axis=-1).astype(jnp.int32),
           jnp.max(preds, axis=-1),
@@ -635,6 +758,8 @@ class ModelRunner:
       main_dev = jax.device_put(main_u8)
       sn_dev = jax.device_put(sn)
     self._n_dispatched += 1
+    if self._device_epilogue:
+      self._n_epilogue_packs += 1
     handle = _DispatchHandle((main_dev, sn_dev), n)
     handle.seq = self._n_dispatched
     self._pending = handle
@@ -669,8 +794,9 @@ class ModelRunner:
       handle.error = faults.classify_device_error(e)
 
   def raw_outputs(self, dispatched: _DispatchHandle):
-    """Device arrays (pred_ids, max_prob, n) for a dispatch handle,
-    launching its forward now if no later dispatch overlapped it."""
+    """Device arrays (pred_ids, max_prob, n) for a dispatch handle —
+    (ids_u8, quals_u8, n) when the device epilogue is on — launching
+    its forward now if no later dispatch overlapped it."""
     handle = dispatched
     if not handle.launched:
       if self._pending is handle:
@@ -696,6 +822,9 @@ class ModelRunner:
         'mesh_dp': self.mesh_dp,
         'inference_dtype': self._inference_dtype_label,
         'n_quantized_matmuls': self._n_quantized_matmuls,
+        'device_epilogue': int(self._device_epilogue),
+        'n_epilogue_packs': self._n_epilogue_packs,
+        'd2h_bytes_per_pack': self._d2h_bytes_per_pack,
     }
 
   @property
@@ -768,21 +897,31 @@ class ModelRunner:
     return self._finalize_sync(dispatched)
 
   def _finalize_sync(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
-    """The blocking half of finalize: device sync + quality math."""
-    pred_ids, max_prob, n = self.raw_outputs(dispatched)
+    """The blocking half of finalize: device sync, plus host quality
+    math only on the fallback path (with the device epilogue on, the
+    quality integers already left the device final — this is a pure
+    uint8 drain)."""
+    out_a, out_b, n = self.raw_outputs(dispatched)
     hang_s = getattr(dispatched, 'hang_s', 0.0)
     if hang_s:
       # Injected device hang (ENV_DEVICE_HANG_AT_PACK): simulate a
       # wedged sync so the watchdog path is provable on CPU.
       dispatched.hang_s = 0.0
       time.sleep(hang_s)
+    if not self._d2h_bytes_per_pack:
+      # Actual drain size: 2 uint8 planes with the epilogue, int32 ids
+      # + f32 max_prob without (the bench A/B's measured numerator).
+      self._d2h_bytes_per_pack = int(out_a.nbytes + out_b.nbytes)
     # Slice on the host: indexing the device array with a varying [:n]
     # would lower (and cache) a fresh jitted slice per tail size.
     # dclint: allow=jit-hazards (finalize IS the sync point: results
     # must land on the host here, after the async dispatch window)
-    pred_ids = np.asarray(pred_ids)[:n]
-    # dclint: allow=jit-hazards (same deliberate sync as pred_ids)
-    max_prob = np.asarray(max_prob)[:n]
+    out_a = np.asarray(out_a)[:n]
+    # dclint: allow=jit-hazards (same deliberate sync as out_a)
+    out_b = np.asarray(out_b)[:n]
+    if self._device_epilogue:
+      return out_a, out_b  # (ids_u8, quals_u8): nothing left to compute
+    pred_ids, max_prob = out_a, out_b
     error_prob = np.maximum(1.0 - max_prob, 1e-12)
     quality = -10.0 * np.log10(error_prob)
     opts = self.options

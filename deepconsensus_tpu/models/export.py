@@ -18,8 +18,10 @@ import jax.numpy as jnp
 from jax import export as jax_export
 import ml_collections
 
+from deepconsensus_tpu.calibration import lib as calibration_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.ops import output_plane
 
 ARTIFACT_NAME = 'serving.stablehlo'
 
@@ -34,8 +36,23 @@ def export_model(
     strict_polymorphic: bool = False,
     inference_dtype: Optional[str] = None,
     quantize_matmuls: Optional[str] = None,
+    device_epilogue: bool = True,
+    max_base_quality: int = 93,
+    dc_calibration: str = 'skip',
 ) -> str:
-  """Exports a serving function rows->softmax; returns artifact path.
+  """Exports a serving function; returns the artifact path.
+
+  With device_epilogue (the default) the whole output plane is
+  compiled into the artifact: the serving call returns the final uint8
+  (base ids, Phred quality) planes — argmax plus the exact
+  threshold-table quality (ops/output_plane.py) for the given
+  dc_calibration / max_base_quality, which are baked into the program
+  and recorded in the metadata (from_exported refuses a load whose
+  quality knobs disagree). Without it, the serving call returns
+  softmax preds and the host computes qualities, as before. The XLA
+  epilogue is used unconditionally here — a Pallas call would pin the
+  artifact to one backend's custom-call ABI; StableHLO keeps it
+  portable.
 
   polymorphic_batch exports the batch dimension symbolically, so the
   artifact serves ANY batch size (the reference's SavedModel does
@@ -76,8 +93,24 @@ def export_model(
 
   variables, _ = quantize_lib.prepare_inference_variables(variables, params)
 
+  thresholds = None
+  if device_epilogue:
+    thresholds = output_plane.quality_thresholds(
+        calibration_lib.parse_calibration_string(dc_calibration),
+        max_base_quality)
+    if thresholds is None:
+      logging.warning(
+          'device epilogue requested but dc_calibration=%r / '
+          'max_base_quality=%d is not device-representable; exporting '
+          'a pre-epilogue (softmax-preds) artifact instead.',
+          dc_calibration, max_base_quality)
+      device_epilogue = False
+
   def serving_fn(rows):
-    return model.apply(variables, rows)
+    preds = model.apply(variables, rows)
+    if thresholds is None:
+      return preds
+    return output_plane.phred_epilogue(preds, thresholds)
 
   static_shape = (batch_size, params.total_rows, params.max_length, 1)
   exported = None
@@ -117,7 +150,10 @@ def export_model(
                'inference_dtype': params.get('inference_dtype', None)
                or 'float32',
                'quantize_matmuls': params.get('quantize_matmuls', None)
-               or 'none'}, f)
+               or 'none',
+               'device_epilogue': bool(device_epilogue),
+               'max_base_quality': int(max_base_quality),
+               'dc_calibration': dc_calibration}, f)
   return artifact
 
 

@@ -572,6 +572,11 @@ class ConsensusService:
     # engine.stats() and replace these defaults below.
     counters.setdefault('inference_dtype', 'float32')
     counters.setdefault('n_quantized_matmuls', 0)
+    # Device-resident output plane (--device_epilogue): uint8 drain
+    # counters, real values ride in the same way.
+    counters.setdefault('device_epilogue', 0)
+    counters.setdefault('n_epilogue_packs', 0)
+    counters.setdefault('d2h_bytes_per_pack', 0)
     with self._lock:
       outstanding = len(self._outstanding)
     out = {

@@ -43,7 +43,12 @@ def quality_score_to_string(score: int) -> str:
 
 def quality_scores_to_string(scores: Union[np.ndarray, List[int]]) -> str:
   """Phred int array -> FASTQ quality string."""
-  arr = (np.asarray(scores, dtype=np.int64) + 33).astype(np.uint8)
+  arr = np.asarray(scores)
+  if arr.dtype == np.uint8:
+    # Device-epilogue drain path: already the FASTQ byte domain minus
+    # the offset — no int64 intermediate.
+    return (arr + np.uint8(33)).tobytes().decode('ascii')
+  arr = (arr.astype(np.int64) + 33).astype(np.uint8)
   return arr.tobytes().decode('ascii')
 
 

@@ -24,6 +24,12 @@
 #                                  # bf16/int8 accuracy gates, fused
 #                                  # encoder-block parity, export
 #                                  # lever baking/mismatch
+#   ./run_all_tests.sh epilogue    # device-resident output plane only:
+#                                  # threshold-table exactness + FASTQ
+#                                  # byte-identity across levers/dp/
+#                                  # serve/export (the fast tier also
+#                                  # runs its single-device identity
+#                                  # subset as an explicit gate)
 #
 # Two-tier structure: the `slow` marker covers the heavy interpret-mode
 # Pallas golden sweeps (wavefront train/VJP/unroll, banded-attention
@@ -42,6 +48,13 @@ fi
 
 if [[ "${1:-}" == "fast" ]]; then
   python -m tools.dclint
+  # Output-plane byte-identity gate: host vs device epilogue FASTQ/
+  # predict identity on synthetic inputs, single-device, < 60 s. Runs
+  # before the main sweep so an identity regression fails loud and
+  # first — byte identity is the invariant that makes --device_epilogue
+  # a pure transfer-format change (docs/inference.md).
+  python -m pytest tests/test_device_epilogue.py -q \
+    -k identity -m 'not multichip'
   exec python -m pytest tests/ -q -m 'not slow'
 fi
 
@@ -72,6 +85,11 @@ fi
 
 if [[ "${1:-}" == "quant" ]]; then
   exec python -m pytest tests/ -q -m quant
+fi
+
+if [[ "${1:-}" == "epilogue" ]]; then
+  exec python -m pytest \
+    tests/test_output_plane.py tests/test_device_epilogue.py -q
 fi
 
 # Static analysis first: dclint runs in under a second and fails fast

@@ -91,6 +91,17 @@ run_stage forward_fullfused 600 \
   --config transformer_learn_values_distill+test \
   --set use_fused_hotpath=true --set inference_dtype=bfloat16 \
   --set quantize_matmuls=int8
+# Device-resident output plane (round-11 beat-or-retire): uint8
+# (ids, quals) drained instead of int32 ids + f32 max_prob, quality
+# computed on device via the threshold table. The bytes/pack 4x is
+# already proven on CPU (bench.py d2h_bytes stage); THIS stage decides
+# windows/s over a real tunnel, where the 4x smaller drain shortens
+# the serialized tail of each transfer/compute overlap window.
+# Decision rule in docs/performance.md (exit 1 = identity violation —
+# investigate before reading the perf numbers).
+run_stage forward_epilogue 600 \
+  python "$REPO/scripts/bench_epilogue.py" --batch 1024 --packs 8 \
+  --config transformer_learn_values_distill+test --fused
 # dp-sharded double-buffered dispatch (round-6 tentpole): real-chip dp
 # scaling of windows/s + transfer-overlap fraction. Staged to fire on
 # first live tunnel; until then the host-platform parity sweep lives

@@ -293,6 +293,38 @@ class TestJitHazards:
         """)
     assert found == []
 
+  def test_catches_asarray_of_epilogue_outputs(self):
+    """The device epilogue's uint8 planes are device values: a host
+    materialisation sneaking in before finalize is flagged."""
+    found = findings_for(jit_hazards, self.RUNNER, '''\
+        import numpy as np
+
+        from deepconsensus_tpu.ops import output_plane
+
+        class R:
+          def dispatch(self, rows):
+            ids, quals = output_plane.phred_epilogue(rows, self._thr)
+            return np.asarray(quals)
+        ''')
+    assert any('materialises a device value' in f.message
+               for f in found)
+
+  def test_passes_double_buffer_transfer_into_epilogue(self):
+    """The epilogue call counts as a forward for the double-buffer
+    rule: a transfer consumed by it is not a hazard."""
+    found = findings_for(jit_hazards, self.RUNNER, '''\
+        import jax
+
+        from deepconsensus_tpu.ops import output_plane
+
+        class R:
+          def dispatch(self, rows):
+            main_dev = jax.device_put(rows, self._sharding)
+            out = output_plane.phred_epilogue(main_dev, self._thr)
+            return out
+        ''')
+    assert found == []
+
 
 # ---------------------------------------------------------------------------
 # jit-hazards: dtype-downcast sub-rule

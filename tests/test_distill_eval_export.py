@@ -114,9 +114,14 @@ def test_export_roundtrip(tmp_path):
       batch_size=4,
       variables=variables,
       params=params,
+      # Pre-epilogue artifact: raw preds are the round-trip observable
+      # here (epilogue-baked exports are covered by
+      # test_device_epilogue.py).
+      device_epilogue=False,
   )
   serving, meta = export_lib.load_exported(out_dir)
   assert meta['batch_size'] == 4
+  assert meta['device_epilogue'] is False
   preds = serving(jnp.asarray(rows_np))
   direct = model.apply(variables, jnp.asarray(rows_np))
   np.testing.assert_allclose(

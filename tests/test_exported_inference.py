@@ -14,7 +14,7 @@ from deepconsensus_tpu.models import (
 )
 
 
-def tiny_export(tmp_path, polymorphic=True):
+def tiny_export(tmp_path, polymorphic=True, **export_kw):
   params = config_lib.get_config('transformer_learn_values+test')
   config_lib.finalize_params(params)
   with params.unlocked():
@@ -32,6 +32,7 @@ def tiny_export(tmp_path, polymorphic=True):
       variables=variables,
       params=params,
       polymorphic_batch=polymorphic,
+      **export_kw,
   )
   return params, model, variables, export_dir
 
@@ -57,7 +58,11 @@ def test_polymorphic_export_serves_any_batch(tmp_path):
   """The exported artifact must match direct model.apply at batch
   sizes other than the export-time recommendation (round-2 artifacts
   baked one batch; the reference SavedModel serves any)."""
-  params, model, variables, export_dir = tiny_export(tmp_path)
+  # Pre-epilogue artifact: raw preds are the comparison observable
+  # (batch polymorphism of epilogue-baked artifacts is exercised via
+  # ModelRunner in test_device_epilogue.py and the dp-mesh test below).
+  params, model, variables, export_dir = tiny_export(
+      tmp_path, device_epilogue=False)
   with open(f'{export_dir}/export_meta.json') as f:
     assert json.load(f)['polymorphic_batch'] is True
   serving, _meta = export_lib.load_exported(export_dir)

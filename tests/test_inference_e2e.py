@@ -123,7 +123,11 @@ def test_compact_dispatch_lossless_with_ccs_bq():
   rows[:, -4:] = rng.uniform(0, 20, (batch, 4, 1, 1)).astype(np.float32)
   variables = model.init(
       jax.random.PRNGKey(0), jnp.zeros((1, n_rows, length, 1)))
-  options = runner_lib.InferenceOptions(batch_size=batch)
+  # Host output plane: raw max_prob is the observable that makes a
+  # transport bit-flip visible at full float precision (the device
+  # epilogue's uint8 planes are covered by test_device_epilogue.py).
+  options = runner_lib.InferenceOptions(batch_size=batch,
+                                        device_epilogue=False)
   runner = runner_lib.ModelRunner(params, variables, options)
 
   pred_ids, max_prob, n = runner.raw_outputs(runner.dispatch(rows))

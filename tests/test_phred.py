@@ -23,6 +23,22 @@ def test_quality_string_roundtrip():
   assert phred.quality_score_to_string(0) == '!'
 
 
+def test_quality_string_uint8_fast_path():
+  # The device-epilogue drain hands uint8 planes straight to the
+  # emitters; the fast path must byte-match the generic int path.
+  scores = [0, 10, 20, 40, 93]
+  want = phred.quality_scores_to_string(scores)
+  got = phred.quality_scores_to_string(np.asarray(scores, np.uint8))
+  assert got == want == '!+5I~'
+  assert phred.quality_scores_to_bytes(
+      np.asarray(scores, np.uint8)) == want.encode('ascii')
+  # Full device range stays lossless (93+33=126 is the top of ASCII
+  # printables, the FASTQ ceiling the epilogue's clamp guarantees).
+  full = np.arange(94, dtype=np.uint8)
+  assert phred.quality_scores_to_string(full) == (
+      phred.quality_scores_to_string(full.astype(np.int64)))
+
+
 def test_avg_phred_prob_domain():
   # Mean in probability domain, not phred domain.
   got = phred.avg_phred([10, 30])

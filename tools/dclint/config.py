@@ -117,6 +117,9 @@ HOT_FUNCTIONS = {
 # last dotted segment.
 DEVICE_SOURCE_CALLS = frozenset({
     '_jit_forward', 'device_put', 'dispatch',
+    # Output-plane epilogues (ops/output_plane.py): their uint8 planes
+    # are device values until the finalize drain.
+    'phred_epilogue', 'phred_epilogue_pallas',
 })
 
 # Function parameters known to carry device values (the engine hands
@@ -140,7 +143,8 @@ HOST_SYNC_CALLS = frozenset({'float', 'int', 'bool', 'asarray', 'array'})
 # double-buffered `device_put` transfer.  A host-materialising use of a
 # transfer result BEFORE this call is an implicit sync that defeats the
 # transfer/compute overlap (jit-hazards double-buffer rule).
-FORWARD_CALLS = frozenset({'_forward'})
+FORWARD_CALLS = frozenset({'_forward', 'phred_epilogue',
+                           'phred_epilogue_pallas'})
 
 # dtype-downcast sub-rule: modules where an unannotated cast to a
 # reduced-precision dtype is flagged.  With bf16 inference live, a
