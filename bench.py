@@ -407,6 +407,103 @@ def _d2h_bytes_stage(details, budget_left, batch=1024, n_iters=3):
   _write_details(details)
 
 
+def _padding_waste_stage(details, budget_left, batch=256, n_windows=1024):
+  """Bucketed vs pad-to-max A/B over one mixed-length window stream
+  (70% L=100, 30% L=200): the same windows run through the engine once
+  with a single max-width bucket (every window padded to 200) and once
+  with the default buckets, on the same weights. Reports windows/s,
+  the padded-position fraction each policy dispatched, and the
+  per-variant compile count (n_forward_shapes: bucketing buys its win
+  for exactly one extra trace). The padded-position fraction is
+  arithmetic over the stream — backend-independent, so the stage also
+  runs in CPU-fallback captures; the windows/s A/B only means
+  something on real hardware (measure_r4.sh stages it as
+  forward_bucketed)."""
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from deepconsensus_tpu.inference import engine as engine_lib
+  from deepconsensus_tpu.inference import runner as runner_lib
+  from deepconsensus_tpu.models import config as config_lib
+  from deepconsensus_tpu.models import model as model_lib
+
+  try:
+    p = config_lib.get_config('transformer_learn_values+test')
+    config_lib.finalize_params(p, is_training=False)
+    buckets = config_lib.DEFAULT_WINDOW_BUCKETS
+    max_b = max(buckets)
+    rng = np.random.default_rng(17)
+    widths = rng.choice(buckets, size=n_windows, p=(0.7, 0.3))
+    wins = [rng.integers(0, 5, size=(p.total_rows, int(w), 1))
+            .astype(np.float32) for w in widths]
+    padded = [np.pad(w, ((0, 0), (0, max_b - w.shape[1]), (0, 0)))
+              for w in wins]
+    variables = model_lib.get_model(p).init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, p.total_rows, p.max_length, 1)))
+  except Exception as e:
+    details['stages']['padding_waste'] = {'error': repr(e)[:200]}
+    _write_details(details)
+    return
+  useful = int(widths.sum())
+  stage = {
+      'n_windows': n_windows,
+      'batch': batch,
+      'mix': {int(b): int((widths == b).sum()) for b in buckets},
+      'variants': {},
+  }
+  for name, variant_buckets, stream in (
+      ('pad_to_max', (max_b,), padded),
+      ('bucketed', buckets, wins)):
+    if budget_left() < 60:
+      stage['variants'][name] = {'error': 'skipped: bench budget exhausted'}
+      continue
+    try:
+      options = runner_lib.InferenceOptions(
+          batch_size=batch, max_passes=p.max_passes,
+          max_length=p.max_length, use_ccs_bq=p.use_ccs_bq)
+      options.window_buckets = variant_buckets
+      runner = runner_lib.ModelRunner(p, dict(variables), options,
+                                      mesh=None)
+      engine = engine_lib.ConsensusEngine(
+          runner, options, deliver=lambda t, ids, quals: None)
+      # Warm every bucket's executable, then time the stream.
+      for b in variant_buckets:
+        runner.predict(np.zeros((batch, p.total_rows, b, 1), np.float32))
+      t0 = time.perf_counter()
+      engine.submit(stream, list(range(n_windows)))
+      engine.flush()
+      dt = time.perf_counter() - t0
+      stats = engine.stats()
+      # Positions actually dispatched: full packs at each bucket's
+      # width, pad rows included.
+      dispatched = sum(
+          stats['n_packs_by_bucket'][b] * batch * b
+          for b in stats['n_packs_by_bucket'])
+      stage['variants'][name] = {
+          'windows_per_sec': round(n_windows / dt, 1),
+          'padded_position_fraction': round(1 - useful / dispatched, 4),
+          'n_packs_by_bucket': {
+              int(b): int(n)
+              for b, n in stats['n_packs_by_bucket'].items()},
+          'n_forward_shapes': stats.get('n_forward_shapes', 0),
+          'host_load': _host_load(),
+      }
+    except Exception as e:
+      stage['variants'][name] = {'error': repr(e)[:200]}
+  pad = stage['variants'].get('pad_to_max', {})
+  buck = stage['variants'].get('bucketed', {})
+  if pad.get('windows_per_sec') and buck.get('windows_per_sec'):
+    stage['speedup_bucketed'] = round(
+        buck['windows_per_sec'] / pad['windows_per_sec'], 3)
+    stage['padding_reduction'] = round(
+        pad['padded_position_fraction']
+        - buck['padded_position_fraction'], 4)
+  details['stages']['padding_waste'] = stage
+  _write_details(details)
+
+
 def main():
   # CPU-fallback mode: the parent sets DC_BENCH_CPU=1 when every TPU
   # probe fails, so the round still records an honest (slow) number
@@ -484,6 +581,11 @@ def main():
     # 4x D2H reduction); the windows/s A/B defers to real hardware.
     if budget_left() > 90:
       _d2h_bytes_stage(details, budget_left)
+    # Same posture: the padded-position fraction is stream arithmetic,
+    # so the bucketed-vs-pad-to-max stage still proves the waste
+    # reduction on CPU; windows/s defers to hardware.
+    if budget_left() > 90:
+      _padding_waste_stage(details, budget_left)
     return
 
   # Stage 2: forward throughput at the production batch size.
@@ -592,6 +694,12 @@ def main():
   # on device vs on host.
   if budget_left() > 120:
     _d2h_bytes_stage(details, budget_left)
+
+  # Stage 5e: bucketed vs pad-to-max dispatch over a mixed-length
+  # window stream (round-12): windows/s, padded-position fraction, and
+  # compile count per variant.
+  if budget_left() > 120:
+    _padding_waste_stage(details, budget_left)
 
   # Stage 6: training throughput (full train step, batch 256), scan DP
   # vs Pallas wavefront-VJP loss. Opportunistic: the train-step compile

@@ -147,6 +147,7 @@ def _add_run(sub):
                  '--on_zmw_error=skip) instead of allocated.')
   _add_epilogue_flag(p)
   _add_quant_flags(p)
+  _add_bucket_flag(p)
   _add_device_fault_flags(p)
 
 
@@ -179,6 +180,30 @@ def _add_quant_flags(p):
                  help='int8: per-channel symmetric weight '
                  'quantization of the encoder attention/FFN matmuls '
                  'at load; dequant runs in the fused-kernel epilogue.')
+
+
+def _parse_window_buckets(text):
+  try:
+    buckets = tuple(int(x) for x in text.split(',') if x.strip())
+  except ValueError:
+    raise argparse.ArgumentTypeError(
+        f'--window_buckets must be comma-separated ints, got {text!r}')
+  if not buckets:
+    raise argparse.ArgumentTypeError('--window_buckets is empty')
+  return buckets
+
+
+def _add_bucket_flag(p):
+  p.add_argument('--window_buckets', default=None,
+                 type=_parse_window_buckets, metavar='L1,L2,...',
+                 help='Window length buckets, e.g. 100,200: each '
+                 'variable-width (smart) window pads to the smallest '
+                 'bucket that fits instead of pad-to-max, and each '
+                 'bucket dispatches through its own compile-once '
+                 'forward (fused hot path for L<=128, XLA above). The '
+                 'smallest bucket must equal the model max_length. '
+                 'Default: the checkpoint\'s params.window_buckets '
+                 '(single-shape when unset).')
 
 
 def _add_device_fault_flags(p):
@@ -261,6 +286,7 @@ def _add_serve(sub):
                  'require tp=1.')
   _add_epilogue_flag(p)
   _add_quant_flags(p)
+  _add_bucket_flag(p)
   _add_device_fault_flags(p)
 
 
@@ -602,6 +628,7 @@ def _dispatch(args) -> int:
         inference_dtype=args.inference_dtype,
         quantize_matmuls=args.quantize_matmuls,
         device_epilogue=args.device_epilogue,
+        window_buckets=args.window_buckets,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal or 'skip'),
         ccs_calibration_values=calibration_lib.parse_calibration_string(
@@ -642,6 +669,10 @@ def _dispatch(args) -> int:
     options.max_passes = runner.params.max_passes
     options.max_length = runner.params.max_length
     options.use_ccs_bq = runner.params.use_ccs_bq
+    options.window_buckets = config_lib.normalize_window_buckets(
+        options.window_buckets
+        or getattr(runner.params, 'window_buckets', None),
+        runner.params.max_length)
     serve_options = ServeOptions(
         max_pending=args.max_pending,
         admit_queue_depth=args.admit_queue_depth,
@@ -694,6 +725,7 @@ def _dispatch(args) -> int:
         inference_dtype=args.inference_dtype,
         quantize_matmuls=args.quantize_matmuls,
         device_epilogue=args.device_epilogue,
+        window_buckets=args.window_buckets,
         pack_across_batches=not args.no_cross_batch_packing,
         max_record_bytes=args.max_record_bytes,
         dc_calibration_values=calibration_lib.parse_calibration_string(

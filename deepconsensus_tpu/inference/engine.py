@@ -129,7 +129,9 @@ class _WindowPacker:
   """
 
   def __init__(self, runner, options, timing_rows: List[Dict[str, Any]],
-               on_pack_failure: PackFailureFn, deliver: DeliverFn):
+               on_pack_failure: PackFailureFn, deliver: DeliverFn,
+               poisoned: Optional[set] = None,
+               pack_clock: Optional[List[int]] = None):
     self._runner = runner
     self._batch = options.batch_size
     self._depth = max(1, options.dispatch_depth)
@@ -141,7 +143,16 @@ class _WindowPacker:
     self._tickets: List[Ticket] = []
     self._buffered = 0
     self._in_flight: 'collections.deque' = collections.deque()
-    self._poisoned: set = set()
+    # Shared across a bucketed engine's packers: one poison set (the
+    # caller doesn't know which bucket a ticket landed in) and one
+    # global pack clock (every bucket's dispatches tick it) so the
+    # starvation rule below can measure "packs the OTHER buckets cut
+    # while my tail sat buffered".
+    self._poisoned: set = poisoned if poisoned is not None else set()
+    self._pack_clock: List[int] = (
+        pack_clock if pack_clock is not None else [0])
+    # Clock reading when the current buffered tail started waiting.
+    self._starve_mark = 0
     self.n_packs = 0
     self.n_pack_rows = 0
     self.n_pad_rows = 0
@@ -153,10 +164,20 @@ class _WindowPacker:
   def add(self, rows: np.ndarray, tickets: Sequence[Ticket]) -> None:
     """Buffers one submission's formatted model rows ([k, R, L, 1],
     aligned with tickets) and dispatches every full pack now cuttable."""
+    if not self._buffered:
+      self._starve_mark = self._pack_clock[0]
     self._rows.append(rows)
     self._tickets.extend(tickets)
     self._buffered += len(rows)
     self._cut_packs(flush=False)
+
+  def maybe_flush_starved(self, limit: int) -> None:
+    """Bucket starvation flush: if this packer's partial tail has sat
+    buffered while the engine (all buckets together) cut >= limit
+    packs, cut it now as a padded partial pack rather than holding its
+    windows hostage to a bucket the input stream rarely feeds."""
+    if self._buffered and self._pack_clock[0] - self._starve_mark >= limit:
+      self._cut_packs(flush=True)
 
   def poison(self, ticket: Ticket) -> None:
     """Fault injection: the pack containing this ticket fails at
@@ -180,6 +201,8 @@ class _WindowPacker:
   def _dispatch(self, pack: np.ndarray, tickets: List[Ticket]) -> None:
     seq = self.n_packs
     self.n_packs += 1
+    self._pack_clock[0] += 1
+    self._starve_mark = self._pack_clock[0]
     self.n_pack_rows += len(pack)
     self.n_pad_rows += self._batch - len(pack)
     try:
@@ -332,9 +355,16 @@ def _raise_pack_failure(tickets, pack_seq: int, error: BaseException):
 class ConsensusEngine:
   """Submit featurized windows, receive finalized uint8 (ids, quals).
 
-  Owns the window packer, the dispatch depth, and (via the ModelRunner
-  / model config) the fused-kernel vs XLA path choice. See the module
-  docstring for the contract; construct via __init__ with an existing
+  Owns one window packer PER LENGTH BUCKET (params.window_buckets /
+  options.window_buckets; single bucket = the historical fixed-shape
+  engine), the dispatch depth, and (via the ModelRunner / model
+  config) the fused-kernel vs XLA path choice — eligibility is
+  per-bucket: traces at L <= the fused VMEM limit run the Pallas hot
+  path, longer buckets the XLA fallback. Mixed-width submissions are
+  grouped by trailing window width; within each bucket, delivery stays
+  in featurize order, so per-bucket output is byte-identical to a
+  single-bucket run over the same windows. See the module docstring
+  for the contract; construct via __init__ with an existing
   ModelRunner or via from_checkpoint.
   """
 
@@ -344,9 +374,74 @@ class ConsensusEngine:
     self.runner = runner
     self.options = options
     self.timing_rows = timing_rows if timing_rows is not None else []
-    self._packer = _WindowPacker(
-        runner, options, self.timing_rows,
-        on_pack_failure or _raise_pack_failure, deliver)
+    self._deliver_fn = deliver
+    self._on_pack_failure = on_pack_failure or _raise_pack_failure
+    self._buckets = self._resolve_buckets()
+    # One packer per bucket, created on first window of that width;
+    # all packers share the poison set and the global pack clock.
+    self._packers: Dict[int, _WindowPacker] = {}
+    self._poisoned: set = set()
+    self._pack_clock: List[int] = [0]
+    self._n_windows_by_bucket: Dict[int, int] = {}
+
+  def _resolve_buckets(self) -> Tuple[int, ...]:
+    buckets = getattr(self.options, 'window_buckets', None)
+    if buckets:
+      return tuple(int(b) for b in buckets)
+    params = getattr(self.runner, 'params', None)
+    if params is not None:
+      from deepconsensus_tpu.models import config as config_lib
+
+      return config_lib.resolve_window_buckets(params)
+    return (int(self.options.max_length),)
+
+  @property
+  def window_buckets(self) -> Tuple[int, ...]:
+    return self._buckets
+
+  def _packer_for(self, width: int) -> _WindowPacker:
+    packer = self._packers.get(width)
+    if packer is None:
+      if width not in self._buckets:
+        # dclint: allow=typed-faults (caller shape contract: windows
+        # must arrive pre-padded to a configured bucket)
+        raise ValueError(
+            f'window width {width} not in window buckets {self._buckets}')
+      packer = _WindowPacker(
+          self.runner, self.options, self.timing_rows,
+          # Indirection so predict_windows can swap the deliver sink
+          # for every bucket at once.
+          lambda ts, seq, err: self._on_pack_failure(ts, seq, err),
+          lambda t, ids, quals: self._deliver_fn(t, ids, quals),
+          poisoned=self._poisoned, pack_clock=self._pack_clock)
+      self._packers[width] = packer
+    return packer
+
+  def _add_rows(self, rows: np.ndarray, tickets: List[Ticket]) -> None:
+    width = int(rows.shape[2])
+    self._n_windows_by_bucket[width] = (
+        self._n_windows_by_bucket.get(width, 0) + len(rows))
+    self._packer_for(width).add(rows, tickets)
+
+  def _flush_starved(self) -> None:
+    limit = int(getattr(self.options, 'bucket_flush_packs', 0) or 0)
+    if limit <= 0 or len(self._packers) < 2:
+      return
+    for width in sorted(self._packers):
+      self._packers[width].maybe_flush_starved(limit)
+
+  @staticmethod
+  def _group_by_width(windows, tickets) -> Dict[int, Tuple[list, list]]:
+    """Groups per-window tensors by trailing window width, preserving
+    submission order within each group (delivery order within a bucket
+    is what the byte-identity contract pins)."""
+    groups: Dict[int, Tuple[list, list]] = {}
+    for w, t in zip(windows, tickets):
+      w = np.asarray(w)
+      ws, ts = groups.setdefault(int(w.shape[-2]), ([], []))
+      ws.append(w)
+      ts.append(t)
+    return groups
 
   @classmethod
   def from_checkpoint(cls, checkpoint_path: str, options,
@@ -355,12 +450,20 @@ class ConsensusEngine:
                       timing_rows: Optional[List[Dict[str, Any]]] = None,
                       mesh=None) -> 'ConsensusEngine':
     from deepconsensus_tpu.inference import runner as runner_lib
+    from deepconsensus_tpu.models import config as config_lib
 
     runner = runner_lib.ModelRunner.from_checkpoint(
         checkpoint_path, options, mesh=mesh)
     options.max_passes = runner.params.max_passes
     options.max_length = runner.params.max_length
     options.use_ccs_bq = runner.params.use_ccs_bq
+    # Bucket-aware options: an explicit options.window_buckets must be
+    # consistent with the checkpoint's base geometry; unset follows
+    # params.window_buckets (single shape when that too is unset).
+    options.window_buckets = config_lib.normalize_window_buckets(
+        getattr(options, 'window_buckets', None) or
+        getattr(runner.params, 'window_buckets', None),
+        runner.params.max_length)
     return cls(runner, options, deliver,
                on_pack_failure=on_pack_failure, timing_rows=timing_rows)
 
@@ -368,11 +471,14 @@ class ConsensusEngine:
   def params(self):
     return self.runner.params
 
-  def submit(self, raw_windows: np.ndarray,
+  def submit(self, raw_windows,
              tickets: Sequence[Ticket]) -> None:
-    """Feeds featurized window tensors ([k, total_rows, L, 1], one
-    ticket per window) through format -> pack -> dispatch. Full packs
-    dispatch immediately; the tail waits for more windows or flush()."""
+    """Feeds featurized window tensors (one ticket per window) through
+    format -> pack -> dispatch. Accepts a uniform [k, total_rows, L, 1]
+    array or a sequence of [total_rows, L, 1] tensors with mixed L;
+    mixed widths are grouped per bucket. Full packs dispatch
+    immediately; each bucket's tail waits for more windows, the
+    starvation flush, or flush()."""
     from deepconsensus_tpu.models import data as data_lib
 
     if len(raw_windows) != len(tickets):
@@ -382,62 +488,107 @@ class ConsensusEngine:
           f'{len(raw_windows)} windows vs {len(tickets)} tickets')
     if not len(raw_windows):
       return
-    rows = data_lib.format_rows_batch(
-        np.asarray(raw_windows), self.runner.params)
-    self._packer.add(rows, list(tickets))
+    if isinstance(raw_windows, np.ndarray) and raw_windows.dtype != object:
+      rows = data_lib.format_rows_batch(
+          np.asarray(raw_windows), self.runner.params,
+          window_buckets=self._buckets)
+      self._add_rows(rows, list(tickets))
+    else:
+      for width, (ws, ts) in sorted(
+          self._group_by_width(raw_windows, tickets).items()):
+        self._add_rows(
+            data_lib.format_rows_batch(np.stack(ws), self.runner.params,
+                                       window_buckets=self._buckets),
+            ts)
+    self._flush_starved()
 
-  def submit_formatted(self, rows: np.ndarray,
+  def submit_formatted(self, rows,
                        tickets: Sequence[Ticket]) -> None:
     """submit() for rows already through data.format_rows_batch (the
-    serve retry path re-dispatches without re-formatting)."""
+    serve retry path re-dispatches without re-formatting). Accepts a
+    uniform [k, R, L, 1] array or a sequence of [R, L, 1] rows with
+    mixed L."""
     if len(rows) != len(tickets):
       # dclint: allow=typed-faults (caller API misuse guard, not a
       # data-plane fault: both args come from the same client code)
       raise ValueError(f'{len(rows)} rows vs {len(tickets)} tickets')
-    if len(rows):
-      self._packer.add(np.asarray(rows), list(tickets))
+    if not len(rows):
+      return
+    if isinstance(rows, np.ndarray) and rows.dtype != object:
+      self._add_rows(np.asarray(rows), list(tickets))
+    else:
+      for _width, (ws, ts) in sorted(
+          self._group_by_width(rows, tickets).items()):
+        self._add_rows(np.stack(ws), ts)
+    self._flush_starved()
 
   def flush(self, drain: bool = True) -> None:
-    """Cuts the buffered tail as a padded pack; with drain, resolves
-    every in-flight pack (every submitted ticket has been delivered or
-    failed when this returns)."""
-    self._packer.flush(drain=drain)
+    """Cuts every bucket's buffered tail as a padded pack; with drain,
+    resolves every in-flight pack (every submitted ticket has been
+    delivered or failed when this returns). Tails cut for all buckets
+    before any drain so the end-of-input packs overlap on device."""
+    for width in sorted(self._packers):
+      self._packers[width].flush(drain=False)
+    if drain:
+      for width in sorted(self._packers):
+        self._packers[width].flush(drain=True)
 
   def poison_ticket(self, ticket: Ticket) -> None:
-    self._packer.poison(ticket)
+    # Shared across buckets: the caller doesn't know (or care) which
+    # bucket the window landed in.
+    self._poisoned.add(id(ticket))
 
   @property
   def has_work(self) -> bool:
     """True while any submitted window is still buffered or in flight."""
-    return self._packer.has_work
+    return any(p.has_work for p in self._packers.values())
+
+  def _agg(self, name: str):
+    return sum(getattr(p, name) for p in self._packers.values())
 
   @property
   def n_packs(self) -> int:
-    return self._packer.n_packs
+    return self._agg('n_packs')
 
   @property
   def n_pack_rows(self) -> int:
-    return self._packer.n_pack_rows
+    return self._agg('n_pack_rows')
 
   @property
   def n_pad_rows(self) -> int:
-    return self._packer.n_pad_rows
+    return self._agg('n_pad_rows')
 
   @property
   def model_wall(self) -> float:
-    return self._packer.model_wall
+    return self._agg('model_wall')
 
   @property
   def n_oom_bisections(self) -> int:
-    return self._packer.n_oom_bisections
+    return self._agg('n_oom_bisections')
 
   @property
   def n_device_faults(self) -> int:
-    return self._packer.n_device_faults
+    return self._agg('n_device_faults')
 
   @property
   def n_dispatch_timeouts(self) -> int:
-    return self._packer.n_dispatch_timeouts
+    return self._agg('n_dispatch_timeouts')
+
+  @property
+  def n_packs_by_bucket(self) -> Dict[int, int]:
+    return {w: self._packers[w].n_packs for w in sorted(self._packers)}
+
+  @property
+  def padding_fraction(self) -> float:
+    """Fraction of positions a pad-to-max policy would have dispatched
+    on top of the bucketed dispatch: 1 - sum(n_b * L_b) / (N * L_max).
+    0.0 with a single bucket or before any window arrives."""
+    total = sum(self._n_windows_by_bucket.values())
+    if not total or len(self._buckets) < 2:
+      return 0.0
+    bucketed = sum(
+        n * w for w, n in self._n_windows_by_bucket.items())
+    return 1.0 - bucketed / (total * max(self._buckets))
 
   def stats(self) -> Dict[str, Any]:
     out = {
@@ -454,24 +605,35 @@ class ConsensusEngine:
     dispatch_stats = getattr(self.runner, 'dispatch_stats', None)
     if dispatch_stats is not None:
       out.update(dispatch_stats())
+    # Bucketed-dispatch counters (after the runner merge: the engine's
+    # per-packer view is authoritative for pack accounting).
+    out['window_buckets'] = list(self._buckets)
+    out['n_packs_by_bucket'] = self.n_packs_by_bucket
+    out['n_windows_by_bucket'] = {
+        w: self._n_windows_by_bucket[w]
+        for w in sorted(self._n_windows_by_bucket)}
+    out['padding_fraction'] = round(self.padding_fraction, 4)
     return out
 
   def predict_windows(
-      self, raw_windows: np.ndarray
-  ) -> Tuple[np.ndarray, np.ndarray]:
+      self, raw_windows
+  ) -> Tuple[Any, Any]:
     """Synchronous convenience: featurized windows -> (ids, quals),
     in submission order. Flushes the pipeline, so only for tools/tests
-    — streaming callers use submit()/flush() with tickets."""
+    — streaming callers use submit()/flush() with tickets. Uniform
+    widths return stacked arrays; mixed widths return aligned lists."""
     results: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
-    save = self._packer._deliver
+    save = self._deliver_fn
     try:
-      self._packer._deliver = (
+      self._deliver_fn = (
           lambda ticket, ids, quals: results.__setitem__(
               ticket, (ids, quals)))
       self.submit(raw_windows, list(range(len(raw_windows))))
       self.flush()
     finally:
-      self._packer._deliver = save
-    ids = np.stack([results[i][0] for i in range(len(raw_windows))])
-    quals = np.stack([results[i][1] for i in range(len(raw_windows))])
+      self._deliver_fn = save
+    ids = [results[i][0] for i in range(len(raw_windows))]
+    quals = [results[i][1] for i in range(len(raw_windows))]
+    if len({i.shape for i in ids}) <= 1:
+      return np.stack(ids), np.stack(quals)
     return ids, quals

@@ -64,7 +64,7 @@ class InferenceOptions:
   """Knobs shared across inference stages
   (reference: quick_inference.py:243-275)."""
 
-  max_length: int = 100
+  max_length: int = config_lib.DEFAULT_MAX_LENGTH
   max_passes: int = 20
   min_quality: int = 20
   min_length: int = 0
@@ -74,6 +74,17 @@ class InferenceOptions:
   skip_windows_above: int = 45
   ins_trim: int = 5
   use_ccs_smart_windows: bool = False
+  # Window length buckets (config.resolve_window_buckets): None = follow
+  # params.window_buckets / single-shape at max_length. Each smart
+  # window pads to the smallest bucket that fits; the engine packs and
+  # dispatches each bucket separately (one compiled shape per bucket).
+  window_buckets: Optional[Tuple[int, ...]] = None
+  # Bucket starvation flush: when one bucket's partial tail has sat
+  # buffered while the other buckets cut this many full packs, the
+  # starved tail is cut as a padded partial pack so a rare bucket's
+  # windows cannot be held back indefinitely behind a busy one
+  # (0 disables; tails always flush at end-of-input regardless).
+  bucket_flush_packs: int = 8
   max_base_quality: int = 93
   limit: int = 0
   # (i, n): keep only ZMWs with zm % n == i — single-flag fleet scaling
@@ -560,6 +571,13 @@ class ModelRunner:
     # Measured at the first finalize drain (actual device-array bytes
     # pulled host-side per pack), for /metricz and the bench A/B.
     self._d2h_bytes_per_pack = 0
+    # Bucketed-dispatch accounting: every distinct (batch, L) input
+    # shape traces (and compiles) the jitted forward once, so the set
+    # size is the compile count the per-bucket compile-once contract
+    # asserts on; the per-bucket dict counts dispatches (including
+    # bisection retries, unlike the engine's per-packer n_packs).
+    self._forward_shapes: set = set()
+    self._n_dispatched_by_bucket: Dict[int, int] = {}
 
   @staticmethod
   def _jit_forward(forward, mesh):
@@ -760,6 +778,12 @@ class ModelRunner:
     self._n_dispatched += 1
     if self._device_epilogue:
       self._n_epilogue_packs += 1
+    # Per-bucket compile-once accounting: jit keeps one executable per
+    # distinct (batch, L); the set is the compile count.
+    width = int(rows.shape[2])
+    self._forward_shapes.add((batch, width))
+    self._n_dispatched_by_bucket[width] = (
+        self._n_dispatched_by_bucket.get(width, 0) + 1)
     handle = _DispatchHandle((main_dev, sn_dev), n)
     handle.seq = self._n_dispatched
     self._pending = handle
@@ -825,6 +849,10 @@ class ModelRunner:
         'device_epilogue': int(self._device_epilogue),
         'n_epilogue_packs': self._n_epilogue_packs,
         'd2h_bytes_per_pack': self._d2h_bytes_per_pack,
+        'n_forward_shapes': len(self._forward_shapes),
+        'n_dispatched_by_bucket': {
+            w: self._n_dispatched_by_bucket[w]
+            for w in sorted(self._n_dispatched_by_bucket)},
     }
 
   @property
@@ -1283,6 +1311,13 @@ def run_inference(
   options.max_passes = params.max_passes
   options.max_length = params.max_length
   options.use_ccs_bq = params.use_ccs_bq
+  # Bucket-aware geometry: an explicit options.window_buckets (CLI
+  # --window_buckets) must be consistent with the checkpoint's base
+  # max_length; unset follows params.window_buckets (single shape when
+  # that too is unset).
+  options.window_buckets = config_lib.normalize_window_buckets(
+      options.window_buckets or getattr(params, 'window_buckets', None),
+      params.max_length)
 
   fail_fast = options.on_zmw_error == faults.OnZmwError.FAIL
   dead_letter: Optional[faults.DeadLetterWriter] = None
@@ -1334,6 +1369,7 @@ def run_inference(
       max_passes=options.max_passes,
       max_length=options.max_length,
       use_ccs_bq=options.use_ccs_bq,
+      window_buckets=options.window_buckets,
   )
   # dclint: lock-free (producer thread owns the feeder's counter while
   # it runs; the main thread merges into it only after the join)
@@ -1731,8 +1767,9 @@ def run_inference(
               (mol,
                mol.append_pending(fd['window_pos'], ccs_ids, ccs_bq)))
         if to_model:
-          raw = np.stack([fd['subreads'] for fd in to_model])
-          engine.submit(raw, slots)
+          # A list (not a stacked array): widths may mix across buckets;
+          # the engine groups per bucket preserving featurize order.
+          engine.submit([fd['subreads'] for fd in to_model], slots)
           if not options.pack_across_batches:
             # Compat/debug mode: pad out this batch's tail instead of
             # carrying it into the next featurize batch's pack.
@@ -1769,8 +1806,8 @@ def run_inference(
             result = stitch.stitch_arrays(
                 name,
                 np.asarray(mol.pos, dtype=np.int64),
-                np.stack(mol.ids),
-                np.stack(mol.quals),
+                mol.ids,
+                mol.quals,
                 max_length=options.max_length,
                 min_quality=options.min_quality,
                 min_length=options.min_length,

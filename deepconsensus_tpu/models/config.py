@@ -19,6 +19,61 @@ import ml_collections
 
 from deepconsensus_tpu.preprocess.pileup import total_rows as _total_rows
 
+# Canonical window geometry. All shape literals live here (dclint's
+# shape-literals checker fences them out of the rest of the tree):
+# DEFAULT_MAX_LENGTH is the reference window length (reference:
+# model_configs.py max_length=100); FUSED_MAX_WINDOW_LEN is the VMEM
+# row budget of the Pallas fused hot path — buckets at or under it run
+# fused, longer buckets fall back to XLA.
+DEFAULT_MAX_LENGTH = 100
+FUSED_MAX_WINDOW_LEN = 128
+# Default bucket set when params.window_buckets is requested but unset
+# by a config: the reference L=100 plus one 2x bucket (the distill
+# configs' target geometry, arxiv 2211.09862).
+DEFAULT_WINDOW_BUCKETS = (100, 200)
+
+
+def normalize_window_buckets(buckets, max_length: int):
+  """Validate and canonicalize a window-bucket spec.
+
+  None/empty means bucketing is off: the single-shape pipeline runs
+  exactly as before with one bucket equal to max_length. A non-empty
+  spec must be strictly ascending positive ints whose smallest entry
+  equals params.max_length — max_length stays the featurize stride and
+  base window geometry; buckets only widen the variable-width (smart
+  window) path.
+  """
+  if not buckets:
+    return (int(max_length),)
+  out = tuple(int(b) for b in buckets)
+  if any(b <= 0 for b in out):
+    raise ValueError(f'window_buckets must be positive ints, got {out}')
+  if list(out) != sorted(set(out)):
+    raise ValueError(
+        f'window_buckets must be strictly ascending, got {out}')
+  if out[0] != int(max_length):
+    raise ValueError(
+        f'smallest window bucket {out[0]} must equal max_length '
+        f'{max_length} (max_length is the featurize stride)')
+  return out
+
+
+def resolve_window_buckets(params):
+  """Bucket set for a params object: normalized params.window_buckets,
+  or the single-bucket (max_length,) when unset."""
+  buckets = getattr(params, 'window_buckets', None)
+  return normalize_window_buckets(buckets, int(params.max_length))
+
+
+def bucket_for(width: int, buckets):
+  """Smallest bucket that fits `width`, or None when it overflows all
+  buckets (the caller's overflow-skip path)."""
+  for b in buckets:
+    if width <= b:
+      return int(b)
+  return None
+
+
 # Transformer size presets (reference: transformer_basic_params.py).
 TRANSFORMER_SIZE_PARAMS = {
     'tiny': dict(
@@ -256,7 +311,7 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   params.loss_reg = 0.1
   params.band_width = ml_collections.config_dict.placeholder(int)
 
-  params.max_length = 100
+  params.max_length = DEFAULT_MAX_LENGTH
 
   params.model_config_name = 'transformer_learn_values'
   params.dataset_config_name = 'ccs'
@@ -284,6 +339,14 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   # the fused kernel epilogue (models/quantize.py).
   params.inference_dtype = ml_collections.config_dict.placeholder(str)
   params.quantize_matmuls = ml_collections.config_dict.placeholder(str)
+  # Window length buckets for variable-width inference (None = single
+  # shape at max_length, the reference behavior). When set (e.g.
+  # (100, 200)), featurize pads each smart window to the smallest
+  # bucket that fits instead of pad-to-max, and the engine packs and
+  # dispatches each bucket separately with one compiled executable per
+  # bucket (resolve_window_buckets / bucket_for above). The smallest
+  # bucket must equal max_length.
+  params.window_buckets = ml_collections.config_dict.placeholder(object)
   # Route AlignmentLoss through the whole-DP Pallas wavefront kernels
   # (forward scorer + custom-VJP backward) instead of the lax.scan DP.
   # Only applies when band_width is None (the training default).

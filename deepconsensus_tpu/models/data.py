@@ -18,6 +18,7 @@ import numpy as np
 from deepconsensus_tpu import constants
 from deepconsensus_tpu.faults import CorruptInputError
 from deepconsensus_tpu.io.example_proto import Example
+from deepconsensus_tpu.models import config
 from deepconsensus_tpu.io.tfrecord import read_tfrecords
 from deepconsensus_tpu.preprocess.pileup import layout_from_shape, row_indices
 from deepconsensus_tpu.utils import phred
@@ -46,10 +47,13 @@ def format_rows(
 def format_rows_batch(
     subreads: np.ndarray,
     params: ml_collections.ConfigDict,
+    window_buckets: Sequence[int] = (),
 ) -> np.ndarray:
   """format_rows over a whole window batch [N, H, L, 1] at once —
   one set of slice/clip/concat ops instead of N (the per-window calls
-  were a measured host-side cost in the inference model stage)."""
+  were a measured host-side cost in the inference model stage).
+  window_buckets overrides the allowed widths (callers whose buckets
+  come from InferenceOptions rather than params)."""
   example_layout = layout_from_shape(subreads.shape[1:], params.use_ccs_bq)
   (base_r, pw_r, ip_r, strand_r, ccs_r, ccs_bq_r, sn_r) = row_indices(
       example_layout.max_passes, params.use_ccs_bq
@@ -71,7 +75,16 @@ def format_rows_batch(
     features.append(rows_of(ccs_bq_r))
   features.append(np.clip(rows_of(sn_r), 0, params.SN_MAX))
   rows = np.concatenate(features, axis=1)
-  expected = (len(subreads), params.total_rows, params.max_length, 1)
+  buckets = (tuple(window_buckets) if window_buckets
+             else config.resolve_window_buckets(params))
+  width = rows.shape[2]
+  if width not in buckets:
+    # dclint: allow=typed-faults (caller shape contract, not a
+    # data-plane fault: the window width must be one of the model's
+    # configured length buckets)
+    raise ValueError(
+        f'window width {width} not in window buckets {buckets}')
+  expected = (len(subreads), params.total_rows, width, 1)
   assert rows.shape == expected, rows.shape
   return rows
 

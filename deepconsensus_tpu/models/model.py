@@ -412,7 +412,10 @@ class DeepConsensusModel(nn.Module):
     the param tree is created identically; training needs gradients and
     dropout the kernel doesn't serve; the kernel assumes the condensed
     learn-values input, a ReZero residual for layer 0, and a window
-    short enough for whole-L score blocks."""
+    short enough for whole-L score blocks. rows.shape is static under
+    trace, so with window buckets the routing is per bucket: each
+    bucket's compiled forward independently picks fused
+    (L <= MAX_WINDOW_LEN) or the XLA fallback."""
     from deepconsensus_tpu.ops import fused_window_attention as fwa
 
     p = self.params

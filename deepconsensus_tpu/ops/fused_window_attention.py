@@ -54,6 +54,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from deepconsensus_tpu import constants
+from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.preprocess.pileup import row_indices
 
 Array = jnp.ndarray
@@ -63,8 +64,9 @@ _NEG = -1e9
 # Above this window length the [tile, L, L] score block stops paying
 # for itself against the flash kernel's structural band; callers fall
 # back to the XLA path / flash kernel (same boundary as
-# flash_band_attention.WHOLE_L_LIMIT).
-MAX_WINDOW_LEN = 128
+# flash_band_attention.WHOLE_L_LIMIT). With window buckets, eligibility
+# is per bucket: traces at L <= this run fused, longer buckets XLA.
+MAX_WINDOW_LEN = config_lib.FUSED_MAX_WINDOW_LEN
 
 # Windows per grid program. 8 keeps the peak VMEM footprint (one-hot
 # chunk + live q/k/v/x values + weights) near 11 MB at the production

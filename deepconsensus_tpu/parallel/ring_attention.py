@@ -29,6 +29,21 @@ Array = jnp.ndarray
 _NEG_INF = -1e30
 
 
+def _mark_varying(x: Array, axis_name: str) -> Array:
+  """Marks x device-varying over axis_name so the scan carry types line
+  up with the ppermuted K/V blocks. jax >= 0.8 spells this
+  jax.lax.pcast(to='varying'), 0.5-0.7 jax.lax.pvary; older versions
+  don't track varying-ness in the type system, so identity is correct
+  there."""
+  pcast = getattr(jax.lax, 'pcast', None)
+  if pcast is not None:
+    return pcast(x, axis_name, to='varying')
+  pvary = getattr(jax.lax, 'pvary', None)
+  if pvary is not None:
+    return pvary(x, axis_name)
+  return x
+
+
 def _block_attention(
     q: Array,
     k: Array,
@@ -72,18 +87,15 @@ def ring_attention(
 
   q_offset = my_index * l_local
 
-  # Online softmax state; pcast(to='varying') marks the zeros as
-  # device-varying so the scan carry types line up with the ppermuted
-  # K/V (pvary is deprecated in favor of pcast).
-  m = jax.lax.pcast(
-      jnp.full((b, h, l_local), _NEG_INF, q.dtype), axis_name,
-      to='varying',
+  # Online softmax state, marked device-varying (see _mark_varying).
+  m = _mark_varying(
+      jnp.full((b, h, l_local), _NEG_INF, q.dtype), axis_name
   )  # running max
-  l_sum = jax.lax.pcast(
-      jnp.zeros((b, h, l_local), q.dtype), axis_name, to='varying'
+  l_sum = _mark_varying(
+      jnp.zeros((b, h, l_local), q.dtype), axis_name
   )  # running denominator
-  o = jax.lax.pcast(
-      jnp.zeros((b, l_local, h, d), q.dtype), axis_name, to='varying'
+  o = _mark_varying(
+      jnp.zeros((b, l_local, h, d), q.dtype), axis_name
   )  # running numerator
 
   perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]

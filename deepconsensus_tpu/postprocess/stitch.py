@@ -182,23 +182,40 @@ def stitch_arrays(
   """Array-native stitch_to_fastq: one molecule's windows as contiguous
   arrays in, (sequence ASCII bytes, phred uint8 array) out.
 
-  window_pos: [n] window start offsets; ids: [n, L] vocab-id uint8;
-  quals: [n, L] phred uint8. The gap strip, quality gate, and ASCII
-  conversion are each a single vectorized pass — no per-window Python
-  objects or intermediate strings. Filter semantics (and counter
-  attribution) match stitch_to_fastq exactly, including the legacy
-  missing-window rule: sorted window k must not start past
-  k * max_length.
+  window_pos: [n] window start offsets; ids: [n, L] vocab-id uint8 —
+  or, for bucketed variable-length windows, a sequence of n 1-D uint8
+  arrays with per-window lengths; quals likewise. The gap strip,
+  quality gate, and ASCII conversion are each a single vectorized pass
+  — no per-window Python objects or intermediate strings. Filter
+  semantics (and counter attribution) match stitch_to_fastq exactly,
+  including the legacy missing-window rule generalized to ragged rows:
+  sorted window k must not start past the cumulative capacity of the
+  windows before it (for uniform L=max_length rows that is exactly the
+  legacy k * max_length bound, so fixed-shape output is byte-identical).
   """
   del molecule_name  # name formatting happens at the emit sink
   n = len(window_pos)
   order = np.argsort(window_pos, kind='stable')
   pos = np.asarray(window_pos)[order]
-  if n == 0 or np.any(pos > np.arange(n, dtype=pos.dtype) * max_length):
+  if isinstance(ids, np.ndarray) and ids.dtype != object:
+    lengths = np.full(n, ids.shape[1] if ids.ndim > 1 else 0,
+                      dtype=np.int64)
+  else:
+    ids = [np.asarray(w) for w in ids]
+    quals = [np.asarray(w) for w in quals]
+    lengths = np.array([len(ids[i]) for i in order], dtype=np.int64)
+  capacity = np.zeros(n, dtype=np.int64)
+  if n:
+    np.cumsum(lengths[:-1], out=capacity[1:])
+  if n == 0 or np.any(pos > capacity):
     outcome_counter.empty_sequence += 1
     return None
-  flat_ids = np.ascontiguousarray(ids[order]).reshape(-1)
-  flat_quals = np.ascontiguousarray(quals[order]).reshape(-1)
+  if isinstance(ids, np.ndarray):
+    flat_ids = np.ascontiguousarray(ids[order]).reshape(-1)
+    flat_quals = np.ascontiguousarray(quals[order]).reshape(-1)
+  else:
+    flat_ids = np.concatenate([ids[i] for i in order])
+    flat_quals = np.concatenate([quals[i] for i in order])
   keep = flat_ids != constants.GAP_INT
   flat_ids = flat_ids[keep]
   if flat_ids.size == 0:

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from deepconsensus_tpu import constants
 from deepconsensus_tpu.io.tfrecord import TFRecordWriter
+from deepconsensus_tpu.models.config import DEFAULT_MAX_LENGTH
 from deepconsensus_tpu.preprocess.feeder import create_proc_feeder
 from deepconsensus_tpu.preprocess.pileup import FeatureLayout
 from deepconsensus_tpu.preprocess.feeder import reads_to_pileup
@@ -33,7 +34,7 @@ def run_preprocess(
     ccs_bam: str,
     output: str,
     max_passes: int = 20,
-    example_width: int = 100,
+    example_width: int = DEFAULT_MAX_LENGTH,
     use_ccs_bq: bool = False,
     ins_trim: int = 5,
     use_ccs_smart_windows: bool = False,

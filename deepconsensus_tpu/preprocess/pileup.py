@@ -27,10 +27,19 @@ class FeatureLayout:
   N_SUBREAD_FEATURES = ('bases', 'pw', 'ip', 'strand')
 
   def __init__(self, max_passes: int, max_length: int,
-               use_ccs_bq: bool = False):
+               use_ccs_bq: bool = False,
+               window_buckets: Optional[Tuple[int, ...]] = None):
     self.max_passes = max_passes
     self.max_length = max_length
     self.use_ccs_bq = use_ccs_bq
+    # Window length buckets for the variable-width (smart windows)
+    # path: a spaced window pads to the smallest bucket that fits
+    # instead of pad-to-max_length, and only windows wider than the
+    # largest bucket overflow. None/empty keeps the single-shape rule.
+    # Rides on the layout so bucketing reaches featurize workers
+    # without widening the feeder plumbing.
+    self.window_buckets = tuple(window_buckets) if window_buckets else (
+        (max_length,))
     self.feature_rows = {
         'bases': max_passes,
         'pw': max_passes,
@@ -76,6 +85,19 @@ def layout_from_shape(shape: Tuple[int, int, int],
   if rem != 0:
     raise ValueError(f'invalid subreads shape {shape!r}')
   return FeatureLayout(max_passes, width, use_ccs_bq)
+
+
+def bucket_window_width(window_width: int,
+                        layout: FeatureLayout) -> Tuple[int, bool]:
+  """(padded_width, overflow) for a spaced window under the layout's
+  bucket set: the smallest bucket that fits, or (window_width, True)
+  past the largest bucket — overflow windows keep their natural width
+  and are triaged to the CCS-fallback path downstream, exactly as the
+  single-shape rule did for window_width > max_length."""
+  for b in layout.window_buckets:
+    if window_width <= b:
+      return int(b), False
+  return int(window_width), True
 
 
 def total_rows(max_passes: int, use_ccs_bq: bool) -> int:
@@ -256,7 +278,13 @@ class Pileup:
         self.counter['n_examples_adjusted_label'] += 1
         window.reads[-1] = adjusted
 
-      overflow = window_width > max_length
+      if self.is_training:
+        # Training keeps the reference single-shape rule; buckets are
+        # an inference-side geometry.
+        width = max(window_width, max_length)
+        overflow = window_width > max_length
+      else:
+        width, overflow = bucket_window_width(window_width, layout)
       if overflow:
         self.counter['n_examples_overflow'] += 1
         if self.is_training:
@@ -264,11 +292,10 @@ class Pileup:
       else:
         self.counter['n_examples_skip_large_windows_keep'] += 1
 
-      reads = [x.pad(max_length) for x in window.reads]
+      reads = [x.pad(width) for x in window.reads]
       out = Pileup(self.name, reads, self.layout, overflow=overflow)
       # Same tail padding rules as AlignedRead.pad: strand/sn repeat,
       # ccs_bq pads with -1, everything else pads with zeros.
-      width = max(window_width, max_length)
       chunk = matrix[:, win_start : win_start + window_width]
       if chunk.shape[1] < width:
         data = np.zeros(
@@ -435,13 +462,12 @@ class Pileup:
       if covered.size == 0:
         self.counter['n_examples_no_ccs_idx'] += 1
         continue
-      overflow = window_width > max_length
+      width, overflow = bucket_window_width(window_width, layout)
       if overflow:
         self.counter['n_examples_overflow'] += 1
       else:
         self.counter['n_examples_skip_large_windows_keep'] += 1
 
-      width = max(window_width, max_length)
       chunk = matrix[:, sl]
       if chunk.shape[1] < width:
         data = np.zeros(

@@ -11,10 +11,16 @@ typed 4xx, not server memory growth (same posture as the PR-4 bounded
 decoders).
 
 Request arrays:
-  subreads    float32 [n, total_rows, max_length, 1]
+  subreads    float32 [n, total_rows, L, 1]
   window_pos  int64   [n]
-  ccs_bq      int32   [n, max_length]   (draft CCS base qualities)
+  ccs_bq      int32   [n, L]   (draft CCS base qualities)
   overflow    uint8   [n]
+
+where L must be one of the model's window length buckets
+(params.window_buckets; max_length alone when bucketing is off). npz
+arrays are rectangular, so one request carries one width; clients with
+different window lengths share the server's per-bucket packs
+concurrently.
   name        0-d str (molecule name)
   meta_json   0-d str (optional: ec / np_num_passes / rq / rg)
 
@@ -79,10 +85,13 @@ def request_from_features(features) -> bytes:
 
 
 def decode_request(body: bytes, *, total_rows: int, max_length: int,
-                   max_windows: int) -> Dict[str, Any]:
+                   max_windows: int,
+                   window_buckets=None) -> Dict[str, Any]:
   """Parses + validates one request body. Raises BadRequestError (400)
   on anything malformed and RequestTooLargeError (413) when the window
-  count exceeds the admission cap."""
+  count exceeds the admission cap. window_buckets: allowed window
+  lengths (defaults to (max_length,))."""
+  allowed = tuple(window_buckets) if window_buckets else (max_length,)
   try:
     with np.load(io.BytesIO(body), allow_pickle=False) as z:
       missing = [f for f in REQUEST_FIELDS if f not in z.files]
@@ -106,16 +115,19 @@ def decode_request(body: bytes, *, total_rows: int, max_length: int,
   if n > max_windows:
     raise faults_lib.RequestTooLargeError(
         f'{n} windows exceeds max_windows_per_request={max_windows}')
-  if subreads.shape[1:] != (total_rows, max_length, 1):
+  if (subreads.ndim != 4 or subreads.shape[1] != total_rows
+      or subreads.shape[2] not in allowed or subreads.shape[3] != 1):
     raise faults_lib.BadRequestError(
         f'subreads shape {subreads.shape} does not match the loaded '
-        f'model: expected [n, {total_rows}, {max_length}, 1]')
+        f'model: expected [n, {total_rows}, L, 1] with window length '
+        f'L in {list(allowed)}')
+  width = int(subreads.shape[2])
   if window_pos.shape != (n,) or overflow.shape != (n,):
     raise faults_lib.BadRequestError(
         'window_pos/overflow must be [n] aligned with subreads')
-  if ccs_bq.shape != (n, max_length):
+  if ccs_bq.shape != (n, width):
     raise faults_lib.BadRequestError(
-        f'ccs_bq shape {ccs_bq.shape} != [n, {max_length}]')
+        f'ccs_bq shape {ccs_bq.shape} != [n, {width}]')
   if not np.isfinite(subreads).all():
     raise faults_lib.BadRequestError('subreads contains non-finite values')
   if not isinstance(meta, dict):

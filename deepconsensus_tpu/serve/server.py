@@ -178,7 +178,8 @@ def _make_handler(service: ConsensusService):
             body,
             total_rows=params.total_rows,
             max_length=params.max_length,
-            max_windows=opts.max_windows_per_request)
+            max_windows=opts.max_windows_per_request,
+            window_buckets=service.engine.window_buckets)
         state = service.submit(req, deadline_s,
                                client=self.address_string())
         result = service.wait(state)

@@ -118,7 +118,10 @@ class _RequestState:
     self.ids: List[Optional[np.ndarray]] = []
     self.quals: List[Optional[np.ndarray]] = []
     self.tickets: List[_Ticket] = []
-    self.model_rows: Optional[np.ndarray] = None
+    # Per-window formatted [total_rows, L, 1] tensors (indexed by
+    # ticket.row); a list, not a stacked array, because one request's
+    # windows may span length buckets.
+    self.model_rows: Optional[List[np.ndarray]] = None
     self.pending = 0
     self.ingested = False
     self.retried = False
@@ -182,8 +185,9 @@ class ConsensusService:
     compilation cache this is a cache hit, not a compile)."""
     params = self.engine.params
     t0 = time.monotonic()
-    self.engine.runner.predict(np.zeros(
-        (1, params.total_rows, params.max_length, 1), dtype=np.float32))
+    for width in self.engine.window_buckets:
+      self.engine.runner.predict(np.zeros(
+          (1, params.total_rows, width, 1), dtype=np.float32))
     self._warm = True
     return time.monotonic() - t0
 
@@ -362,11 +366,27 @@ class ConsensusService:
     state.ingested = True
     state.req = None  # the raw request tensors are no longer needed
     if to_model:
-      raw = np.stack([fd['subreads'] for fd in to_model])
-      # Formatted once and retained: isolation retries re-dispatch the
-      # same rows without the raw tensors (~34 KB/window).
-      state.model_rows = data_lib.format_rows_batch(
-          raw, self.engine.params)
+      # Formatted once and retained per window: isolation retries
+      # re-dispatch the same rows without the raw tensors
+      # (~34 KB/window). Formatting batches per width group (a
+      # mixed-length request spans buckets); submit hands the whole
+      # list to the engine, which regroups per bucket and lets windows
+      # from concurrent requests share each bucket's packs.
+      groups: Dict[int, Tuple[List[int], List[np.ndarray]]] = {}
+      for row, fd in enumerate(to_model):
+        rows_idx, raws = groups.setdefault(
+            int(fd['subreads'].shape[1]), ([], []))
+        rows_idx.append(row)
+        raws.append(fd['subreads'])
+      formatted: List[Optional[np.ndarray]] = [None] * len(to_model)
+      for width in sorted(groups):
+        rows_idx, raws = groups[width]
+        batch = data_lib.format_rows_batch(
+            np.stack(raws), self.engine.params,
+            window_buckets=self.engine.window_buckets)
+        for row, formatted_row in zip(rows_idx, batch):
+          formatted[row] = formatted_row
+      state.model_rows = formatted
       poison = os.environ.get(shared_faults.ENV_POISON_WINDOW)
       if poison and poison in state.name:
         self.engine.poison_ticket(state.tickets[0])
@@ -428,7 +448,7 @@ class ConsensusService:
         # window, which fails solo just as it failed shared).
         self.engine.poison_ticket(ts[0])
       self.engine.submit_formatted(
-          state.model_rows[[t.row for t in ts]], ts)
+          [state.model_rows[t.row] for t in ts], ts)
       self.engine.flush(drain=True)
 
   def _quarantine_request(self, state: _RequestState, ts: List[_Ticket],
@@ -476,8 +496,8 @@ class ConsensusService:
         stitched = stitch.stitch_arrays(
             state.name,
             np.asarray(state.pos, dtype=np.int64),
-            np.stack(state.ids),
-            np.stack(state.quals),
+            state.ids,
+            state.quals,
             max_length=self.options.max_length,
             min_quality=self.options.min_quality,
             min_length=self.options.min_length,
@@ -577,6 +597,13 @@ class ConsensusService:
     counters.setdefault('device_epilogue', 0)
     counters.setdefault('n_epilogue_packs', 0)
     counters.setdefault('d2h_bytes_per_pack', 0)
+    # Bucketed dispatch (--window_buckets): per-bucket pack counts,
+    # compile count (distinct compiled forward shapes), and the
+    # measured pad-to-max waste avoided; real values ride in from
+    # engine.stats() the same way.
+    counters.setdefault('n_packs_by_bucket', {})
+    counters.setdefault('n_forward_shapes', 0)
+    counters.setdefault('padding_fraction', 0.0)
     with self._lock:
       outstanding = len(self._outstanding)
     out = {

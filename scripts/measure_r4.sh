@@ -102,6 +102,15 @@ run_stage forward_fullfused 600 \
 run_stage forward_epilogue 600 \
   python "$REPO/scripts/bench_epilogue.py" --batch 1024 --packs 8 \
   --config transformer_learn_values_distill+test --fused
+# Bucketed variable-length windows (round-12 beat-or-retire): one
+# mixed L={100,200} stream through the engine, pad-to-max vs
+# per-bucket packs. Reads: speedup_bucketed vs the padding_reduction
+# (the win should track the padded-position fraction removed), and
+# n_forward_shapes (=2: bucketing pays exactly one extra trace).
+# Exit 1 = per-bucket byte-identity violation — investigate first.
+run_stage forward_bucketed 900 \
+  python "$REPO/scripts/bench_bucketed.py" --batch 1024 --windows 4096 \
+  --fused
 # dp-sharded double-buffered dispatch (round-6 tentpole): real-chip dp
 # scaling of windows/s + transfer-overlap fraction. Staged to fire on
 # first live tunnel; until then the host-platform parity sweep lives

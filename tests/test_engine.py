@@ -209,6 +209,171 @@ def test_flush_without_drain_leaves_packs_in_flight(params):
 
 
 # ----------------------------------------------------------------------
+# Bucketed variable-length windows: per-bucket packing + ragged dispatch
+
+
+def _win(params, length, rng):
+  return rng.integers(
+      0, 5, size=(params.total_rows, length, 1)).astype(np.float32)
+
+
+def _bucketed_engine(params, batch_size=BATCH, fail_packs=(),
+                     buckets=(100, 200), flush_packs=8):
+  runner, options = _stub_runner(params, batch_size, fail_packs)
+  options.window_buckets = buckets
+  options.bucket_flush_packs = flush_packs
+  delivered = {}
+  failures = []
+  engine = engine_lib.ConsensusEngine(
+      runner, options,
+      deliver=lambda t, ids, quals: delivered.__setitem__(t, (ids, quals)),
+      on_pack_failure=lambda ts, seq, e: failures.append((list(ts), seq, e)))
+  return engine, delivered, failures
+
+
+def test_mixed_length_submission_routes_per_bucket(params):
+  """One submit carrying L=100 and L=200 windows routes each to its
+  bucket's packer; every ticket delivers at its window's natural width
+  (no pad-to-max) and the per-bucket counters account for all of it."""
+  rng = np.random.default_rng(21)
+  engine, delivered, failures = _bucketed_engine(params, batch_size=4)
+  widths = (100, 200, 100, 200, 100, 100)
+  wins = [_win(params, w, rng) for w in widths]
+  engine.submit(wins, list(range(len(wins))))
+  engine.flush()
+  assert not failures
+  mp = params.max_passes
+  for i, w in enumerate(wins):
+    np.testing.assert_array_equal(
+        delivered[i][0], w[4 * mp, :, 0].astype(np.uint8))
+    assert delivered[i][1].shape == (w.shape[1],)
+  stats = engine.stats()
+  assert stats['window_buckets'] == [100, 200]
+  assert stats['n_windows_by_bucket'] == {100: 4, 200: 2}
+  assert stats['n_packs_by_bucket'] == {100: 1, 200: 1}
+  # Bucketed dispatch moved 4*100 + 2*200 = 800 positions where
+  # pad-to-max would have moved 6*200 = 1200.
+  assert stats['padding_fraction'] == pytest.approx(1 - 800 / 1200, abs=1e-4)
+
+
+def test_single_bucket_reports_zero_padding_fraction(params):
+  engine, _, _ = _bucketed_engine(params, buckets=(100,))
+  engine.submit(_raw_windows(params, 3, seed=2), [0, 1, 2])
+  engine.flush()
+  assert engine.stats()['padding_fraction'] == 0.0
+
+
+def test_ragged_tails_flush_in_both_buckets(params):
+  """Both buckets hold sub-batch tails at end of input: flush() cuts
+  each as its own padded pack and no window crosses buckets."""
+  rng = np.random.default_rng(22)
+  engine, delivered, _ = _bucketed_engine(params)
+  wins = ([_win(params, 100, rng) for _ in range(3)]
+          + [_win(params, 200, rng) for _ in range(5)])
+  engine.submit(wins, list(range(len(wins))))
+  assert engine.n_packs == 0  # neither bucket reached batch_size
+  engine.flush()
+  assert engine.n_packs_by_bucket == {100: 1, 200: 1}
+  assert engine.n_pack_rows == 8
+  assert engine.n_pad_rows == 2 * BATCH - 8
+  assert set(delivered) == set(range(len(wins)))
+  for i, w in enumerate(wins):
+    assert delivered[i][0].shape == (w.shape[1],)
+
+
+def test_bucket_starvation_flush(params):
+  """A tail stranded in a rarely-fed bucket is force-cut (padded) once
+  the engine as a whole has dispatched bucket_flush_packs packs since
+  the tail started waiting — it can't sit buffered until end of input
+  behind a stream of full packs in the other bucket."""
+  rng = np.random.default_rng(23)
+  engine, delivered, _ = _bucketed_engine(params, flush_packs=2)
+  engine.submit([_win(params, 200, rng)], ['tail'])
+  engine.submit([_win(params, 100, rng) for _ in range(BATCH)],
+                [('a', i) for i in range(BATCH)])
+  # One pack cut since the tail buffered: below the limit, still held.
+  assert engine.n_packs_by_bucket.get(200, 0) == 0
+  engine.submit([_win(params, 100, rng) for _ in range(BATCH)],
+                [('b', i) for i in range(BATCH)])
+  # Second pack hit the limit: the tail was cut as a padded pack.
+  assert engine.n_packs_by_bucket[200] == 1
+  assert engine.n_pad_rows == BATCH - 1
+  engine.flush()
+  assert delivered['tail'][0].shape == (200,)
+  # The cut reset the mark: nothing further to flush, no empty packs.
+  assert engine.n_packs == 3
+
+
+def test_poison_in_one_bucket_leaves_other_bucket_identical(params):
+  """Poisoning a ticket whose window lands in the 200-bucket fails only
+  that bucket's pack; the 100-bucket's deliveries are byte-identical to
+  the same run without the poison."""
+  rng = np.random.default_rng(24)
+  widths = (100, 200, 100, 200, 100, 100, 200, 100)
+  wins = [_win(params, w, rng) for w in widths]
+
+  def run(poison_idx=None):
+    engine, delivered, failures = _bucketed_engine(params, batch_size=4)
+    tickets = list(range(len(wins)))
+    if poison_idx is not None:
+      engine.poison_ticket(tickets[poison_idx])
+    engine.submit(wins, tickets)
+    engine.flush()
+    return delivered, failures
+
+  clean, clean_failures = run()
+  poisoned, failures = run(poison_idx=3)  # a 200-bucket window
+  assert not clean_failures
+  assert len(failures) == 1
+  failed_tickets, _seq, err = failures[0]
+  assert 'poison' in str(err)
+  # Exactly the 200-bucket tickets failed; every 100-bucket ticket
+  # delivered bytes identical to the clean run.
+  assert failed_tickets == [i for i, w in enumerate(widths) if w == 200]
+  for i, w in enumerate(widths):
+    if w == 100:
+      np.testing.assert_array_equal(poisoned[i][0], clean[i][0])
+      np.testing.assert_array_equal(poisoned[i][1], clean[i][1])
+    else:
+      assert i not in poisoned
+
+
+def test_submit_rejects_width_outside_buckets(params):
+  engine, _, _ = _bucketed_engine(params)
+  rng = np.random.default_rng(25)
+  with pytest.raises(ValueError, match='not in window buckets'):
+    engine.submit([_win(params, 150, rng)], [0])
+
+
+def test_engine_compiles_once_per_bucket(params):
+  """Two buckets cost exactly two forward traces; every later pack —
+  full or padded, either width — reuses its bucket's executable. The
+  runner's n_forward_shapes counter exposes the same fact."""
+  variables = model_lib.get_model(params).init(
+      jax.random.PRNGKey(0),
+      jnp.zeros((1, params.total_rows, params.max_length, 1)))
+  options = runner_lib.InferenceOptions(batch_size=4)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  options.window_buckets = (100, 200)
+  runner = runner_lib.ModelRunner(params, variables, options)
+  engine = engine_lib.ConsensusEngine(
+      runner, options, deliver=lambda t, ids, quals: None)
+  rng = np.random.default_rng(26)
+  # Warm both buckets (one trace each).
+  engine.predict_windows([_win(params, 100, rng), _win(params, 200, rng)])
+  with jtu.count_jit_and_pmap_lowerings() as count:
+    out_ids, _ = engine.predict_windows(
+        [_win(params, w, rng) for w in (100, 200, 200, 100, 100, 200)])
+    assert [i.shape[0] for i in out_ids] == [100, 200, 200, 100, 100, 200]
+  assert count[0] == 0, (
+      f'{count[0]} re-lowerings across bucketed packs: each bucket '
+      'must compile once and reuse its executable')
+  assert runner.dispatch_stats()['n_forward_shapes'] == 2
+
+
+# ----------------------------------------------------------------------
 # Behavior preservation: engine-direct output == batch pipeline output
 
 

@@ -44,3 +44,17 @@ def test_ring_long_sequence(seq_mesh):
   got = ra.ring_attention_sharded(q, k, v, seq_mesh, 'seq',
                                   attn_win_size=32)
   np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_ring_bucket_width_200(seq_mesh):
+  # L=200 is the default second window bucket (models/config.py
+  # DEFAULT_WINDOW_BUCKETS): above the fused-kernel VMEM limit, so a
+  # 200-bucket pack runs the XLA fallback on one device — but ring
+  # attention is the escape hatch if buckets ever grow past what a
+  # single device holds. Parity at the bucket width keeps that path
+  # honest. 200 doesn't divide 8-way, so shard the padded length.
+  q, k, v = make_qkv(b=1, l=208, h=2, d=8, seed=3)
+  want = ra.full_attention_reference(q, k, v, attn_win_size=32)
+  got = ra.ring_attention_sharded(q, k, v, seq_mesh, 'seq',
+                                  attn_win_size=32)
+  np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)

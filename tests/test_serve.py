@@ -87,6 +87,9 @@ def serve_ctx(params, tmp_path):
 
   def make(**overrides):
     runner, options, control = _stub_runner(params)
+    buckets = overrides.pop('window_buckets', None)
+    if buckets:
+      options.window_buckets = buckets
     so_kw = dict(
         io_timeout_s=2.0,
         default_deadline_s=20.0,
@@ -110,15 +113,16 @@ def serve_ctx(params, tmp_path):
     ctx.service.drain(timeout=10)
 
 
-def _mol(params, name, n=4, seed=0):
+def _mol(params, name, n=4, seed=0, width=None):
+  width = width or params.max_length
   rng = np.random.default_rng(seed)
   return dict(
       name=name,
       subreads=rng.integers(
-          0, 5, size=(n, params.total_rows, params.max_length, 1)
+          0, 5, size=(n, params.total_rows, width, 1)
       ).astype(np.float32),
-      window_pos=np.arange(n, dtype=np.int64) * params.max_length,
-      ccs_bq=np.full((n, params.max_length), 30, dtype=np.int32),
+      window_pos=np.arange(n, dtype=np.int64) * width,
+      ccs_bq=np.full((n, width), 30, dtype=np.int32),
       overflow=np.zeros(n, dtype=np.uint8),
   )
 
@@ -172,6 +176,46 @@ def test_concurrent_clients_byte_identical_to_solo(serve_ctx, params):
   # Shared packs actually happened: fewer packs than requests' windows
   # would need unbatched.
   assert stats['n_model_packs'] < sum(3 + i % 4 for i in range(10))
+
+
+def test_mixed_width_clients_share_per_bucket_packs(serve_ctx, params):
+  """Clients sending L=100 and L=200 requests concurrently each get
+  their solo bytes back; the engine packs each width into its own
+  bucket's shared packs and reports per-bucket counters in /metricz."""
+  ctx = serve_ctx(window_buckets=(100, 200))
+  assert ctx.client.wait_ready(10)
+  mols = [_mol(params, f'm/{i}/ccs', n=3 + i % 3, seed=i,
+               width=200 if i % 2 else 100)
+          for i in range(10)]
+  solo = [ctx.client.polish(**m) for m in mols]
+  results = [None] * len(mols)
+  errors = []
+
+  def worker(i):
+    try:
+      results[i] = ServeClient(port=ctx.port, timeout=30).polish(**mols[i])
+    except Exception as e:
+      errors.append(e)
+
+  threads = [threading.Thread(target=worker, args=(i,))
+             for i in range(len(mols))]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join(30)
+  assert not errors
+  for i, (s, r) in enumerate(zip(solo, results)):
+    assert r['status'] == 'ok', i
+    assert r['seq'] == s['seq'], i
+    np.testing.assert_array_equal(r['quals'], s['quals'])
+  m = ctx.client.metricz()
+  counters = m['faults']
+  assert set(map(int, counters['n_packs_by_bucket'])) == {100, 200}
+  assert counters['padding_fraction'] > 0
+  assert m['window_buckets'] == [100, 200]
+  # A width outside the buckets is a 400, not an engine fault.
+  with pytest.raises(ServeClientError, match='400'):
+    ctx.client.polish(**_mol(params, 'm/bad/ccs', width=150))
 
 
 def test_metricz_hammer_during_soak_exact_counters(serve_ctx, params):

@@ -94,3 +94,87 @@ def test_stitch_fill_n_false_fails():
   outs = [make_output(0, 'ACGT'), make_output(8, 'TTGG')]
   seq, qual = stitch.get_full_sequence(outs, max_length=4, fill_n=False)
   assert seq is None
+
+
+# ----------------------------------------------------------------------
+# stitch_arrays ragged rows (bucketed variable-length windows)
+
+
+def _arr_windows(widths, base=1):
+  """Per-window (pos, ids, quals) with distinct id values per window."""
+  import numpy as np
+
+  pos, ids, quals = [], [], []
+  start = 0
+  for k, w in enumerate(widths):
+    pos.append(start)
+    ids.append(np.full(w, base + (k % 4), dtype=np.uint8))
+    quals.append(np.full(w, 30 + k, dtype=np.uint8))
+    start += w
+  return np.asarray(pos, dtype=np.int64), ids, quals
+
+
+def test_stitch_arrays_ragged_matches_uniform():
+  """A list of equal-length 1-D windows must produce byte-identical
+  output to the stacked 2-D path (the fixed-shape byte-identity
+  contract the ragged generalization preserves)."""
+  import numpy as np
+
+  pos, ids, quals = _arr_windows([4, 4, 4])
+  c1, c2 = stitch.OutcomeCounter(), stitch.OutcomeCounter()
+  uniform = stitch.stitch_arrays(
+      'm/1/ccs', pos, np.stack(ids), np.stack(quals),
+      max_length=4, min_quality=0, min_length=0, outcome_counter=c1)
+  ragged = stitch.stitch_arrays(
+      'm/1/ccs', pos, ids, quals,
+      max_length=4, min_quality=0, min_length=0, outcome_counter=c2)
+  assert uniform[0] == ragged[0]
+  np.testing.assert_array_equal(uniform[1], ragged[1])
+  assert c1.success == c2.success == 1
+
+
+def test_stitch_arrays_mixed_widths():
+  """Windows of different bucket widths concatenate in position order;
+  output length is the sum of the per-window lengths."""
+  import numpy as np
+
+  pos, ids, quals = _arr_windows([4, 8, 4])
+  counter = stitch.OutcomeCounter()
+  seq, q = stitch.stitch_arrays(
+      'm/1/ccs', pos, ids, quals,
+      max_length=4, min_quality=0, min_length=0, outcome_counter=counter)
+  assert len(seq) == 16 and len(q) == 16
+  # Position order survives even when windows arrive shuffled.
+  shuffle = [2, 0, 1]
+  counter2 = stitch.OutcomeCounter()
+  seq2, q2 = stitch.stitch_arrays(
+      'm/1/ccs', pos[shuffle], [ids[i] for i in shuffle],
+      [quals[i] for i in shuffle],
+      max_length=4, min_quality=0, min_length=0, outcome_counter=counter2)
+  assert seq2 == seq
+  np.testing.assert_array_equal(q2, q)
+
+
+def test_stitch_arrays_ragged_missing_window_fails():
+  """The missing-window rule generalizes to cumulative capacity: a
+  window starting past the sum of the lengths before it fails the
+  molecule (uniform rows degrade to the legacy k*max_length bound)."""
+  import numpy as np
+
+  pos, ids, quals = _arr_windows([4, 8, 4])
+  # Drop the middle (8-wide) window: window at pos 12 > capacity 4.
+  counter = stitch.OutcomeCounter()
+  assert stitch.stitch_arrays(
+      'm/1/ccs', pos[[0, 2]], [ids[0], ids[2]], [quals[0], quals[2]],
+      max_length=4, min_quality=0, min_length=0,
+      outcome_counter=counter) is None
+  assert counter.empty_sequence == 1
+  # An all-200-style uniform wide molecule is NOT falsely flagged: two
+  # 8-wide windows at 0 and 8 pass even though max_length is 4.
+  counter = stitch.OutcomeCounter()
+  pos2, ids2, quals2 = _arr_windows([8, 8])
+  seq, _ = stitch.stitch_arrays(
+      'm/1/ccs', pos2, np.stack(ids2), np.stack(quals2),
+      max_length=4, min_quality=0, min_length=0, outcome_counter=counter)
+  assert len(seq) == 16
+  assert counter.success == 1

@@ -199,7 +199,7 @@ MUTATING_METHODS = frozenset({
 # shape-literals
 # ---------------------------------------------------------------------------
 
-SHAPE_LITERAL_VALUES = frozenset({100, 128})
+SHAPE_LITERAL_VALUES = frozenset({100, 128, 200})
 
 # The one place window-shape defaults may live.
 SHAPE_LITERALS_EXEMPT = ('deepconsensus_tpu/models/config.py',)
