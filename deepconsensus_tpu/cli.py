@@ -290,6 +290,67 @@ def _add_serve(sub):
   _add_device_fault_flags(p)
 
 
+def _add_route(sub):
+  p = sub.add_parser(
+      'route',
+      help='Fleet front tier: load-balance /v1/polish across dctpu '
+      'serve replicas, steering bam/1 bodies through featurize '
+      'workers first.')
+  p.add_argument('--replica', action='append', default=[],
+                 metavar='HOST:PORT',
+                 help='Model replica address; repeatable. Replicas '
+                 'join health-gated (no traffic until /readyz '
+                 'passes); more can join at runtime via '
+                 'POST /v1/register.')
+  p.add_argument('--featurize_worker', action='append', default=[],
+                 metavar='HOST:PORT',
+                 help='Featurize worker address; repeatable. bam/1 '
+                 'requests are featurized here before a model '
+                 'replica sees them.')
+  p.add_argument('--host', default='127.0.0.1')
+  p.add_argument('--port', type=int, default=8765)
+  p.add_argument('--probe_interval_s', type=float, default=0.5,
+                 help='Health/signal probe cadence per replica '
+                 '(/readyz + /metricz).')
+  p.add_argument('--max_inflight', type=int, default=8,
+                 help='Bounded in-flight requests per replica, scaled '
+                 'by its mesh_dp; when every ready replica is at its '
+                 'bound the router sheds with a typed 503.')
+  p.add_argument('--max_attempts', type=int, default=3,
+                 help='Distinct replicas tried per request; only '
+                 'requests a replica provably never accepted are '
+                 'retried.')
+  p.add_argument('--io_timeout_s', type=float, default=20.0)
+  p.add_argument('--upstream_timeout_s', type=float, default=300.0,
+                 help='End-to-end budget for one forwarded request.')
+  p.add_argument('--max_body_mb', type=int, default=64)
+
+
+def _add_featurize_worker(sub):
+  p = sub.add_parser(
+      'featurize-worker',
+      help='Disaggregated featurize tier: BAM decode/pileup on CPU '
+      'boxes, shipping compact uint8 window packs to model replicas.')
+  p.add_argument('--host', default='127.0.0.1')
+  p.add_argument('--port', type=int, default=8766)
+  p.add_argument('--config', default='transformer_learn_values+test',
+                 help='Model preset naming the feature layout '
+                 '(max_passes/max_length/use_ccs_bq) this worker '
+                 'produces; must match the model replicas behind the '
+                 'same router.')
+  p.add_argument('--ins_trim', type=int, default=0)
+  p.add_argument('--use_ccs_smart_windows', action='store_true')
+  p.add_argument('--work_dir', default=None,
+                 help='Scratch dir for per-request mini BAMs (use a '
+                 'tmpfs in production).')
+  p.add_argument('--no_compact', action='store_true',
+                 help='Always ship legacy float32 frames instead of '
+                 'features/1 uint8 packs.')
+  p.add_argument('--io_timeout_s', type=float, default=20.0)
+  p.add_argument('--max_body_mb', type=int, default=64)
+  _add_bucket_flag(p)
+
+
 def _add_validate(sub):
   p = sub.add_parser(
       'validate',
@@ -492,6 +553,8 @@ def build_parser() -> argparse.ArgumentParser:
   _add_preprocess(sub)
   _add_run(sub)
   _add_serve(sub)
+  _add_route(sub)
+  _add_featurize_worker(sub)
   _add_validate(sub)
   _add_lint(sub)
   _add_train(sub)
@@ -687,6 +750,63 @@ def _dispatch(args) -> int:
     stats = server_lib.serve_main(
         runner, options, serve_options,
         host=args.host, port=args.port,
+        ready_fn=lambda info: print(json.dumps(info), flush=True))
+    print(json.dumps({'event': 'drained', **stats}, default=str),
+          flush=True)
+    return 0 if stats.get('drained') else 1
+
+  if args.command == 'route':
+    import json
+
+    from deepconsensus_tpu.fleet import router as router_lib
+
+    if not args.replica and not args.featurize_worker:
+      raise ValueError(
+          'route needs at least one --replica or --featurize_worker')
+    options = router_lib.RouterOptions(
+        max_body_bytes=args.max_body_mb << 20,
+        io_timeout_s=args.io_timeout_s,
+        upstream_timeout_s=args.upstream_timeout_s,
+        probe_interval_s=args.probe_interval_s,
+        max_inflight=args.max_inflight,
+        max_attempts=args.max_attempts,
+    )
+    stats = router_lib.route_main(
+        replicas=args.replica,
+        featurize_workers=args.featurize_worker,
+        options=options,
+        host=args.host, port=args.port,
+        ready_fn=lambda info: print(json.dumps(info), flush=True))
+    print(json.dumps({'event': 'drained', **stats}, default=str),
+          flush=True)
+    return 0 if stats.get('drained') else 1
+
+  if args.command == 'featurize-worker':
+    import json
+
+    from deepconsensus_tpu.fleet import featurize_worker as worker_lib
+    from deepconsensus_tpu.models import config as config_lib
+
+    params = config_lib.get_config(args.config)
+    config_lib.finalize_params(params, is_training=False)
+    buckets = config_lib.normalize_window_buckets(
+        args.window_buckets
+        or getattr(params, 'window_buckets', None),
+        params.max_length)
+    options = worker_lib.FeaturizeWorkerOptions(
+        max_passes=params.max_passes,
+        max_length=params.max_length,
+        use_ccs_bq=params.use_ccs_bq,
+        window_buckets=tuple(buckets or ()),
+        ins_trim=args.ins_trim,
+        use_ccs_smart_windows=args.use_ccs_smart_windows,
+        work_dir=args.work_dir,
+        compact=not args.no_compact,
+        max_body_bytes=args.max_body_mb << 20,
+        io_timeout_s=args.io_timeout_s,
+    )
+    stats = worker_lib.worker_main(
+        options, host=args.host, port=args.port,
         ready_fn=lambda info: print(json.dumps(info), flush=True))
     print(json.dumps({'event': 'drained', **stats}, default=str),
           flush=True)

@@ -149,6 +149,28 @@ class RequestTooLargeError(BadRequestError):
   http_status = 413
 
 
+class FleetRejection(ServeRejection):
+  """`dctpu route` could not place the request on any replica: every
+  eligible replica of the required tier is saturated (at its bounded
+  in-flight cap), draining, or dead. Transient (UNAVAILABLE): capacity
+  returns when a replica drains its queue or rejoins."""
+
+  http_status = 503
+
+  def __init__(self, detail: str):
+    super().__init__(f'UNAVAILABLE: {detail}')
+
+
+class ReplicaLostError(FleetRejection):
+  """A replica died after the router finished sending it a request
+  (the replica may have accepted the work), so the router must NOT
+  retry elsewhere — a blind retry could duplicate an accepted request.
+  Surfaced to the client as a transient 503; requests the dead replica
+  provably never read ARE retried router-side and never raise this."""
+
+  http_status = 503
+
+
 class CrashLoopError(RuntimeError):
   """Raised by run_training_with_retry when restarts stop making
   progress: the same resume step across K consecutive transient

@@ -1,0 +1,23 @@
+"""Fleet tier: `dctpu route` load balancing + disaggregated featurize.
+
+One resident `dctpu serve` daemon owns one device set; fleet scale is
+N of them behind a router, with CPU-heavy BAM decode/pileup pushed
+out to horizontally scaled featurize workers (the genomics analog of
+prefill/decode disaggregation — accelerator replicas run nothing but
+dispatch/finalize).
+
+  registry.py          health-gated replica registration + probing
+  balancer.py          weighted least-loaded pick, bounded in-flight
+  router.py            `dctpu route`: the /v1/polish front tier
+  featurize_worker.py  `dctpu featurize-worker`: bam/1 -> features/1
+"""
+from deepconsensus_tpu.fleet.registry import (  # noqa: F401
+    FEATURIZE_TIER,
+    MODEL_TIER,
+    Replica,
+    ReplicaRegistry,
+    ReplicaState,
+)
+from deepconsensus_tpu.fleet.balancer import (  # noqa: F401
+    LeastLoadedBalancer,
+)

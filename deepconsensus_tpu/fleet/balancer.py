@@ -1,0 +1,102 @@
+"""Weighted least-loaded replica pick with bounded in-flight counts.
+
+The score is work-per-capacity: (router in-flight + replica queue
+depth) / mesh_dp, with a degraded replica (its mesh stepped down a dp
+level but /readyz stays green) weighted at half capacity so the
+healthy replicas absorb more of the load. queue_depth comes from the
+registry's cached /metricz probe, in_flight is the router's own
+ground truth — together they see both work this router placed and
+work other routers/clients placed directly.
+
+In-flight is bounded per replica at max_inflight * mesh_dp: one slow
+replica saturates its own bound and the pick moves on; when every
+eligible replica of the tier is at its bound the fleet is saturated
+and the caller sheds with a typed FleetRejection (503, transient) —
+the router never queues, so backpressure reaches clients immediately.
+
+acquire() and its in-flight increment are one atomic step under the
+registry lock: two handler threads can't both claim the last slot.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu.fleet import registry as registry_lib
+
+
+class LeastLoadedBalancer:
+
+  def __init__(self, registry: registry_lib.ReplicaRegistry,
+               max_inflight: int = 8):
+    self._registry = registry
+    self.max_inflight = max_inflight
+
+  def _bound(self, replica: registry_lib.Replica) -> int:
+    return self.max_inflight * max(1, replica.mesh_dp)
+
+  def _score(self, replica: registry_lib.Replica) -> float:
+    weight = max(1, replica.mesh_dp) * (0.5 if replica.degraded else 1.0)
+    return (replica.in_flight + replica.queue_depth) / weight
+
+  def acquire(self, tier: str,
+              exclude: Iterable[str] = ()) -> registry_lib.Replica:
+    """Picks the least-loaded READY replica of `tier` (skipping urls in
+    `exclude` — the retry path never re-picks a replica it already
+    tried) and claims one in-flight slot on it. Raises FleetRejection
+    when no replica is eligible or every eligible one is at its
+    in-flight bound."""
+    excluded = set(exclude)
+    with self._registry.lock:
+      tier_members = [
+          r for r in self._registry._replicas.values() if r.tier == tier
+      ]
+      candidates = [
+          r for r in tier_members
+          if r.state == registry_lib.ReplicaState.READY
+          and r.url not in excluded
+      ]
+      open_slots = [r for r in candidates if r.in_flight < self._bound(r)]
+      if not open_slots:
+        if not tier_members:
+          raise shared_faults.FleetRejection(
+              f'no {tier} replicas registered')
+        if not candidates:
+          raise shared_faults.FleetRejection(
+              f'no {tier} replica is ready '
+              f'({self._describe(tier_members, excluded)})')
+        raise shared_faults.FleetRejection(
+            f'all {len(candidates)} ready {tier} replica(s) are at '
+            f'their in-flight bound (max_inflight={self.max_inflight} '
+            'per dp)')
+      best = min(open_slots, key=lambda r: (self._score(r), r.url))
+      best.in_flight += 1
+      best.n_routed += 1
+      return dataclasses.replace(best)
+
+  def release(self, url: str, outcome: str) -> None:
+    """Returns a slot. outcome: 'ok' | 'reject' (upstream typed 4xx/
+    5xx rejection) | 'send_failure' (never acked) | 'lost' (acked,
+    replica died)."""
+    with self._registry.lock:
+      replica = self._registry._replicas.get(url)
+      if replica is None:
+        return
+      replica.in_flight = max(0, replica.in_flight - 1)
+      if outcome == 'ok':
+        replica.n_ok += 1
+      elif outcome == 'reject':
+        replica.n_upstream_rejects += 1
+      elif outcome == 'send_failure':
+        replica.n_send_failures += 1
+      elif outcome == 'lost':
+        replica.n_lost += 1
+
+  @staticmethod
+  def _describe(members, excluded) -> str:
+    parts = []
+    for r in members:
+      note = ' (excluded)' if r.url in excluded else ''
+      parts.append(f'{r.url}={r.state}{note}')
+    return ', '.join(sorted(parts))

@@ -69,7 +69,9 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     DrainingError,
     ExportedArtifactMismatchError,
     FaultKind,
+    FleetRejection,
     NonFiniteTrainingError,
+    ReplicaLostError,
     RequestTooLargeError,
     ServeRejection,
     classify_device_error,

@@ -1,10 +1,23 @@
-from deepconsensus_tpu.models.config import (  # noqa: F401
-    get_config,
-    finalize_params,
-    read_params_from_json,
-    save_params_as_json,
-)
-from deepconsensus_tpu.models.model import (  # noqa: F401
-    DeepConsensusModel,
-    get_model,
-)
+"""Model package. Re-exports resolve lazily (PEP 562): config.py is
+numpy-only, model.py pulls in flax/jax — featurize workers read the
+feature-layout presets from config on jax-free CPU boxes, and an eager
+model import here would drag the whole jax stack along."""
+
+_CONFIG_EXPORTS = ('get_config', 'finalize_params',
+                   'read_params_from_json', 'save_params_as_json')
+_MODEL_EXPORTS = ('DeepConsensusModel', 'get_model')
+
+__all__ = list(_CONFIG_EXPORTS + _MODEL_EXPORTS)
+
+
+def __getattr__(name):
+  if name in _CONFIG_EXPORTS:
+    from deepconsensus_tpu.models import config
+
+    return getattr(config, name)
+  if name in _MODEL_EXPORTS:
+    from deepconsensus_tpu.models import model
+
+    return getattr(model, name)
+  raise AttributeError(
+      f'module {__name__!r} has no attribute {name!r}')

@@ -12,8 +12,24 @@ Layers (each importable on its own):
   /healthz, /readyz, /metricz) and serve_main with SIGTERM drain.
 * client.py   — ServeClient plus the raw-socket fault senders used by
   scripts/inject_faults.py.
+
+The re-exports below resolve lazily (PEP 562): service.py pulls in the
+jax-backed engine, but protocol/server/client are pure stdlib+numpy —
+featurize workers and routers import those on jax-free CPU boxes, and
+an eager `from .service import ...` here would defeat that.
 """
-from deepconsensus_tpu.serve.service import (  # noqa: F401
-    ConsensusService,
-    ServeOptions,
-)
+
+_SERVICE_EXPORTS = ('ConsensusService', 'ServeOptions')
+
+__all__ = list(_SERVICE_EXPORTS)
+
+
+def __getattr__(name):
+  if name in _SERVICE_EXPORTS:
+    from deepconsensus_tpu.serve import service
+
+    return getattr(service, name)
+  # dclint: allow=typed-faults (PEP 562 module __getattr__ must raise
+  # AttributeError — anything else breaks hasattr/dir/import machinery)
+  raise AttributeError(
+      f'module {__name__!r} has no attribute {name!r}')

@@ -116,13 +116,28 @@ class ServeClient:
     del ctype
     return protocol.decode_response(resp_body)
 
-  def polish_features(self, features, deadline_s: Optional[float] = None
-                      ) -> Dict[str, Any]:
-    """polish() from preprocess window feature dicts."""
-    body = protocol.request_from_features(features)
+  def polish_features(self, features, deadline_s: Optional[float] = None,
+                      compact: bool = False) -> Dict[str, Any]:
+    """polish() from preprocess window feature dicts. compact=True
+    ships a features/1 uint8 pack (~4x fewer wire bytes) when the
+    tensor packs losslessly, silently falling back to the legacy
+    float32 frame when it doesn't — the server reconstructs the exact
+    same tensor either way."""
+    body = None
+    if compact:
+      body = protocol.features_pack_from_features(features)
+    if body is None:
+      body = protocol.request_from_features(features)
     fd0 = features[0]
     name = (fd0['name'] if isinstance(fd0['name'], str)
             else fd0['name'].decode())
+    return self.polish_body(body, name=name, deadline_s=deadline_s)
+
+  def polish_body(self, body: bytes, name: str = '',
+                  deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """POSTs an already-encoded /v1/polish body (legacy, features/1,
+    or — against a router — bam/1). The featurize tier and the soak
+    harness reuse this to ship packs without re-encoding."""
     sabotaged = maybe_sabotage(self.host, self.port, name, body)
     if sabotaged:
       return {'status': 'client-fault', 'mode': sabotaged,
@@ -139,6 +154,15 @@ class ServeClient:
         payload = {'error': resp_body[:200].decode('latin-1')}
       raise ServeClientError(status, payload)
     return protocol.decode_response(resp_body)
+
+  def polish_bam(self, subreads_bam: bytes, ccs_bam: bytes,
+                 name: str = '',
+                 deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """polish() from one molecule's raw mini-BAM bytes, for use
+    against a `dctpu route` front tier with a featurize tier behind
+    it (a bare model replica answers a typed 400)."""
+    body = protocol.encode_bam_request(subreads_bam, ccs_bam, name=name)
+    return self.polish_body(body, name=name, deadline_s=deadline_s)
 
 
 # ----------------------------------------------------------------------

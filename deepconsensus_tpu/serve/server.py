@@ -36,7 +36,11 @@ from typing import Any, Dict, Optional
 
 from deepconsensus_tpu import faults as shared_faults
 from deepconsensus_tpu.serve import protocol
-from deepconsensus_tpu.serve.service import ConsensusService, ServeOptions
+
+# ConsensusService/ServeOptions are imported inside serve_main: the
+# service pulls in the jax-backed engine, and fleet's CPU-only tiers
+# (dctpu route / featurize-worker) reuse this module's socket plumbing
+# without paying for it. Annotations stay as strings (PEP 563).
 
 log = logging.getLogger(__name__)
 
@@ -256,6 +260,8 @@ def serve_main(runner, options, serve_options: ServeOptions,
   bound port. stop_event (threading.Event) is the in-process stand-in
   for SIGTERM when serve_main runs off the main thread.
   """
+  from deepconsensus_tpu.serve.service import ConsensusService
+
   service = ConsensusService(runner, options, serve_options)
   warm_s = service.warmup()
   service.start()

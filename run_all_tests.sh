@@ -24,6 +24,10 @@
 #                                  # bf16/int8 accuracy gates, fused
 #                                  # encoder-block parity, export
 #                                  # lever baking/mismatch
+#   ./run_all_tests.sh fleet       # fleet tier only: `dctpu route`
+#                                  # balancing/retry semantics,
+#                                  # featurize workers, protocol
+#                                  # version negotiation
 #   ./run_all_tests.sh epilogue    # device-resident output plane only:
 #                                  # threshold-table exactness + FASTQ
 #                                  # byte-identity across levers/dp/
@@ -85,6 +89,10 @@ fi
 
 if [[ "${1:-}" == "quant" ]]; then
   exec python -m pytest tests/ -q -m quant
+fi
+
+if [[ "${1:-}" == "fleet" ]]; then
+  exec scripts/run_resilience.sh --fleet
 fi
 
 if [[ "${1:-}" == "epilogue" ]]; then

@@ -25,6 +25,15 @@
 #                                         # watchdog, OOM bisection,
 #                                         # mesh degradation (dp 8->4)
 #                                         # incl. byte-identity drills
+#   scripts/run_resilience.sh --fleet     # fleet tier only: `dctpu
+#                                         # route` balancing + retry
+#                                         # semantics, featurize
+#                                         # workers, protocol version
+#                                         # negotiation (the multi-
+#                                         # replica rolling-restart
+#                                         # acceptance demo is
+#                                         # scripts/soak_e2e.py
+#                                         # --fleet 3)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -56,6 +65,15 @@ if [[ "${1:-}" == "--device" ]]; then
   # forces via --xla_force_host_platform_device_count).
   exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_device_faults.py \
+    -q --continue-on-collection-errors "$@"
+fi
+
+if [[ "${1:-}" == "--fleet" ]]; then
+  shift
+  # The fleet tier in isolation: router + registry + balancer +
+  # featurize-worker semantics, all in-process (fast).
+  exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fleet.py \
     -q --continue-on-collection-errors "$@"
 fi
 

@@ -21,6 +21,18 @@ own /metricz counters.
 
   python scripts/soak_e2e.py --serve 8 --serve_rounds 20
 
+Fleet mode (--fleet N): N `dctpu serve` replicas behind one `dctpu
+route` front tier, all real subprocesses sharing one persistent
+compilation cache dir. Concurrent clients hammer the router; halfway
+through, one replica is rolling-restarted (SIGTERM -> drain -> respawn
+-> POST /v1/register) while traffic continues. A disaggregated leg
+ships per-molecule raw mini BAMs (bam/1) through a featurize worker.
+Gates: zero accepted-then-lost requests, every routed result
+byte-identical to a solo single-replica baseline, clean drains
+everywhere.
+
+  python scripts/soak_e2e.py --fleet 3 --serve_rounds 6
+
 Chaos mode (--chaos): same batch soak, but one device OOM and one
 device hang are injected mid-stream via the DCTPU_FAULT_DEVICE_* env
 hooks. The child runs with --on_device_error=degrade and a dispatch
@@ -139,30 +151,23 @@ def count_fastq_records(path: str) -> int:
   return n // 4
 
 
-def serve_soak(args) -> int:
-  """Multi-client soak of a resident `dctpu serve` daemon."""
-  sys.path.insert(0, os.path.dirname(os.path.dirname(
-      os.path.abspath(__file__))))
+def _featurize_synth(args, n_zmws):
+  """Synthesizes molecules and featurizes them once in the parent.
+  Returns (molecules, synth_dir)."""
   from deepconsensus_tpu.inference import runner as runner_lib
   from deepconsensus_tpu.models import config as config_lib
   from deepconsensus_tpu.preprocess import (FeatureLayout,
                                             create_proc_feeder)
-  from deepconsensus_tpu.serve.client import ServeClient, ServeClientError
   from scripts.inject_faults import write_synthetic_zmw_bams
 
   os.makedirs(args.out_dir, exist_ok=True)
-  synth_dir = os.path.join(args.out_dir, f'serve_synth_{args.serve_zmws}')
+  synth_dir = os.path.join(args.out_dir, f'serve_synth_{n_zmws}')
   if not os.path.isdir(synth_dir):
-    write_synthetic_zmw_bams(synth_dir, n_zmws=args.serve_zmws,
+    write_synthetic_zmw_bams(synth_dir, n_zmws=n_zmws,
                              n_subreads=5, seq_len=600)
   sub_bam = os.path.join(synth_dir, 'subreads_to_ccs.bam')
   ccs_bam = os.path.join(synth_dir, 'ccs.bam')
-
-  # Featurize every molecule once in the parent; clients re-send the
-  # same feature payloads all soak long (the daemon does triage + model
-  # + stitch per request).
-  config = 'transformer_learn_values+test'
-  params = config_lib.get_config(config)
+  params = config_lib.get_config('transformer_learn_values+test')
   config_lib.finalize_params(params, is_training=False)
   options = runner_lib.InferenceOptions(min_quality=0)
   options.max_passes = params.max_passes
@@ -179,6 +184,291 @@ def serve_soak(args) -> int:
     features, _ = runner_lib.preprocess_zmw(zmw_input, options)
     if features:
       molecules.append(features)
+  return molecules, synth_dir
+
+
+def _spawn(cmd_tail, env):
+  """Starts a dctpu subcommand subprocess and returns (proc, ready)
+  once its ready JSON line arrives."""
+  proc = subprocess.Popen(
+      [sys.executable, '-m', 'deepconsensus_tpu.cli'] + cmd_tail,
+      env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+      text=True)
+  for line in proc.stdout:
+    if line.startswith('{'):
+      info = json.loads(line)
+      if info.get('event') == 'ready':
+        return proc, info
+  raise RuntimeError(f'subprocess exited before ready: {cmd_tail}')
+
+
+def _drained_line(proc):
+  out = {}
+  for line in proc.stdout.read().splitlines():
+    if line.startswith('{'):
+      d = json.loads(line)
+      if d.get('event') == 'drained':
+        out = d
+  return out
+
+
+def fleet_soak(args) -> int:
+  """N serve replicas behind `dctpu route`, with a rolling restart
+  mid-soak and a disaggregated bam/1 leg."""
+  sys.path.insert(0, os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+  from deepconsensus_tpu.serve.client import ServeClient, ServeClientError
+  from scripts.inject_faults import write_synthetic_zmw_bams
+
+  t0 = time.time()
+  molecules, _synth_dir = _featurize_synth(args, args.serve_zmws)
+  print(f'featurized {len(molecules)} molecules', flush=True)
+
+  env = dict(os.environ)
+  env['PYTHONPATH'] = '/root/repo:' + env.get('PYTHONPATH', '')
+  env['JAX_PLATFORMS'] = env.get('JAX_PLATFORMS', 'cpu')
+  cache_dir = os.path.join(args.out_dir, 'jit_cache')
+  os.makedirs(cache_dir, exist_ok=True)
+
+  def spawn_replica():
+    return _spawn(
+        ['serve', '--random_init',
+         '--config', 'transformer_learn_values+test',
+         '--port', '0', '--min_quality', '0',
+         '--batch_size', str(args.serve_batch_size),
+         '--compilation_cache_dir', cache_dir], env)
+
+  replicas = []  # [proc, port] — mutated by the rolling restart
+  t_first = time.time()
+  for i in range(args.fleet):
+    proc, ready = spawn_replica()
+    replicas.append([proc, ready['port']])
+    print(json.dumps({'replica': i, **ready,
+                      'spawn_s': round(time.time() - t_first, 1)}),
+          flush=True)
+    t_first = time.time()
+
+  worker_proc, worker_ready = _spawn(
+      ['featurize-worker', '--config', 'transformer_learn_values+test',
+       '--port', '0'], env)
+  print(json.dumps(worker_ready), flush=True)
+
+  router_cmd = ['route', '--port', '0', '--probe_interval_s', '0.2',
+                '--featurize_worker',
+                f'127.0.0.1:{worker_ready["port"]}']
+  for _, port in replicas:
+    router_cmd += ['--replica', f'127.0.0.1:{port}']
+  router_proc, router_ready = _spawn(router_cmd, env)
+  print(json.dumps(router_ready), flush=True)
+  router_port = router_ready['port']
+  router_client = ServeClient(port=router_port, timeout=300)
+  if not router_client.wait_ready(120):
+    print('router never became ready', flush=True)
+    return 1
+
+  # Solo baseline: one pass straight at replica 0 — the bytes every
+  # routed result must reproduce exactly.
+  solo_client = ServeClient(port=replicas[0][1], timeout=300)
+  solo = {}
+  for features in molecules:
+    resp = solo_client.polish_features(features)
+    name = features[0]['name']
+    name = name if isinstance(name, str) else name.decode()
+    solo[name] = (resp['status'], resp['seq'],
+                  None if resp['quals'] is None
+                  else resp['quals'].tobytes())
+
+  lock = threading.Lock()
+  latencies = []
+  mismatches = []
+  accepted_then_lost = []
+  errors = []
+  n_ok = [0]
+  n_shed_retries = [0]
+  stop_workers = threading.Event()
+
+  def worker(wid):
+    client = ServeClient(port=router_port, timeout=300)
+    start = wid % max(1, len(molecules))
+    rotated = molecules[start:] + molecules[:start]
+    for _ in range(args.serve_rounds):
+      for features in rotated:
+        if stop_workers.is_set():
+          return
+        name = features[0]['name']
+        name = name if isinstance(name, str) else name.decode()
+        t_req = time.monotonic()
+        resp = None
+        for _attempt in range(40):
+          try:
+            resp = client.polish_features(
+                features, compact=wid % 2 == 0)
+            break
+          except ServeClientError as e:
+            msg = str(e.payload.get('error', ''))
+            if 'accepting' in msg:
+              # The one error a correct client must NOT retry.
+              with lock:
+                accepted_then_lost.append(f'{name}: {msg}')
+              break
+            if e.status in (429, 503):
+              with lock:
+                n_shed_retries[0] += 1
+              time.sleep(0.25)  # fleet busy/rolling; try again
+              continue
+            with lock:
+              errors.append(f'{name}: HTTP {e.status} {msg}')
+            break
+          except OSError as e:
+            with lock:
+              errors.append(f'{name}: {type(e).__name__}')
+            break
+        if resp is None:
+          continue
+        dt = time.monotonic() - t_req
+        got = (resp['status'], resp['seq'],
+               None if resp['quals'] is None
+               else resp['quals'].tobytes())
+        with lock:
+          latencies.append(dt)
+          if got != solo[name]:
+            mismatches.append(name)
+          else:
+            n_ok[0] += 1
+
+  threads = [threading.Thread(target=worker, args=(w,))
+             for w in range(args.fleet_clients)]
+  for t in threads:
+    t.start()
+
+  # Rolling restart mid-soak: SIGTERM replica 0, wait for its clean
+  # drain, respawn with the shared compile cache, register the new
+  # replica with the running router.
+  time.sleep(2.0)
+  old_proc, old_port = replicas[0]
+  old_proc.send_signal(signal.SIGTERM)
+  roll_rc = old_proc.wait(timeout=300)
+  roll_drained = bool(_drained_line(old_proc).get('drained'))
+  new_proc, new_ready = spawn_replica()
+  replicas[0] = [new_proc, new_ready['port']]
+  status, body, _ = router_client._request(
+      'POST', '/v1/register',
+      body=json.dumps({'url': f'127.0.0.1:{new_ready["port"]}',
+                       'tier': 'model'}).encode())
+  rolled = {
+      'old_port': old_port, 'old_rc': roll_rc,
+      'old_drained': roll_drained,
+      'new_port': new_ready['port'],
+      'register_status': status,
+      'register_body': json.loads(body),
+  }
+  print(json.dumps({'event': 'rolled', **rolled}), flush=True)
+
+  for t in threads:
+    t.join()
+
+  # Disaggregated leg: per-molecule raw mini BAMs through the router's
+  # featurize tier; solo-replica polish of the monolithic featurize of
+  # the same BAMs is the identity reference.
+  bam_ok, bam_mismatch = 0, 0
+  for i in range(3):
+    d = os.path.join(args.out_dir, f'fleet_bam_{i}')
+    sub_path, ccs_path = write_synthetic_zmw_bams(
+        d, n_zmws=1, n_subreads=5, seq_len=600, seed=100 + i)
+    with open(sub_path, 'rb') as f:
+      sub_bytes = f.read()
+    with open(ccs_path, 'rb') as f:
+      ccs_bytes = f.read()
+    got = router_client.polish_bam(sub_bytes, ccs_bytes, name=f'bam/{i}')
+    # Monolithic reference: featurize the exact BAM pair we shipped,
+    # polish on a replica directly.
+    from deepconsensus_tpu.inference import runner as runner_lib
+    from deepconsensus_tpu.models import config as config_lib
+    from deepconsensus_tpu.preprocess import (FeatureLayout,
+                                              create_proc_feeder)
+    params = config_lib.get_config('transformer_learn_values+test')
+    config_lib.finalize_params(params, is_training=False)
+    layout = FeatureLayout(params.max_passes, params.max_length,
+                           params.use_ccs_bq)
+    feeder, _ = create_proc_feeder(
+        subreads_to_ccs=sub_path, ccs_bam=ccs_path, layout=layout)
+    options = runner_lib.InferenceOptions(min_quality=0)
+    options.max_passes = params.max_passes
+    options.max_length = params.max_length
+    options.use_ccs_bq = params.use_ccs_bq
+    want = None
+    for zmw_input in feeder():
+      features, _ = runner_lib.preprocess_zmw(zmw_input, options)
+      if features:
+        want = ServeClient(
+            port=replicas[1][1] if len(replicas) > 1
+            else replicas[0][1],
+            timeout=300).polish_features(features)
+    same = (want is not None and got['status'] == want['status']
+            and got['seq'] == want['seq'])
+    bam_ok += bool(same)
+    bam_mismatch += not same
+
+  metricz = router_client.metricz()
+
+  # Drain the fleet: router first (stops admissions), then tiers.
+  router_proc.send_signal(signal.SIGTERM)
+  router_rc = router_proc.wait(timeout=300)
+  router_drained = bool(_drained_line(router_proc).get('drained'))
+  tier_rcs = []
+  for proc, _port in replicas + [[worker_proc, None]]:
+    proc.send_signal(signal.SIGTERM)
+    tier_rcs.append(proc.wait(timeout=300))
+
+  lat = sorted(latencies)
+  verdict = {
+      'soak': 'fleet',
+      'n_replicas': args.fleet,
+      'n_clients': args.fleet_clients,
+      'n_molecules': len(molecules),
+      'n_requests_verified': n_ok[0],
+      'n_mismatches': len(mismatches),
+      'n_accepted_then_lost': len(accepted_then_lost),
+      'n_shed_retries': n_shed_retries[0],
+      'n_client_errors': len(errors),
+      'bam_leg': {'ok': bam_ok, 'mismatched': bam_mismatch},
+      'rolled': rolled,
+      'p50_s': round(lat[len(lat) // 2], 4) if lat else None,
+      'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4)
+               if lat else None,
+      'router_counters': metricz.get('router', {}),
+      'router_latency': metricz.get('latency', {}),
+      'router_rc': router_rc,
+      'router_drained': router_drained,
+      'tier_rcs': tier_rcs,
+      'wall_s': round(time.time() - t0, 1),
+  }
+  print(json.dumps(verdict), flush=True)
+  if mismatches:
+    print(f'MISMATCHED vs solo: {sorted(set(mismatches))[:10]}',
+          flush=True)
+  if accepted_then_lost:
+    print(f'ACCEPTED-THEN-LOST: {accepted_then_lost[:10]}', flush=True)
+  ok = (not mismatches and not accepted_then_lost and not errors
+        and n_ok[0] > 0 and rolled['old_rc'] == 0
+        and rolled['old_drained'] and rolled['register_status'] == 200
+        and router_rc == 0 and router_drained
+        and all(rc == 0 for rc in tier_rcs)
+        and bam_mismatch == 0 and bam_ok > 0)
+  return 0 if ok else 1
+
+
+def serve_soak(args) -> int:
+  """Multi-client soak of a resident `dctpu serve` daemon."""
+  sys.path.insert(0, os.path.dirname(os.path.dirname(
+      os.path.abspath(__file__))))
+  from deepconsensus_tpu.serve.client import ServeClient, ServeClientError
+
+  # Featurize every molecule once in the parent; clients re-send the
+  # same feature payloads all soak long (the daemon does triage + model
+  # + stitch per request).
+  config = 'transformer_learn_values+test'
+  molecules, synth_dir = _featurize_synth(args, args.serve_zmws)
   print(f'featurized {len(molecules)} molecules from {synth_dir}',
         flush=True)
 
@@ -306,6 +596,13 @@ def main():
                   help='ZMW count for the synthetic fallback when the '
                   'reference testdata is absent (~5.8 ZMW/s on the '
                   '1-core CPU host -> 4000 gives a >10 min soak)')
+  ap.add_argument('--fleet', type=int, default=0, metavar='N',
+                  help='Fleet mode: N serve replicas behind `dctpu '
+                  'route` (real subprocesses, shared compile cache), '
+                  'rolling restart mid-soak, disaggregated bam/1 leg.')
+  ap.add_argument('--fleet_clients', type=int, default=4,
+                  help='Fleet mode: concurrent clients through the '
+                  'router.')
   ap.add_argument('--serve', type=int, default=0, metavar='N',
                   help='Serve mode: soak one `dctpu serve` daemon with '
                   'N concurrent clients instead of the batch pipeline.')
@@ -339,6 +636,9 @@ def main():
                   help='Chaos mode: watchdog bound on the blocking '
                   'device sync in the child.')
   args = ap.parse_args()
+
+  if args.fleet > 0:
+    return fleet_soak(args)
 
   if args.serve > 0:
     return serve_soak(args)

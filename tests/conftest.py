@@ -47,6 +47,12 @@ def pytest_configure(config):
       'matmuls) — accuracy gates and export plumbing '
       '(run_all_tests.sh quant)',
   )
+  config.addinivalue_line(
+      'markers',
+      'fleet: multi-replica fleet tier tests — dctpu route balancing/'
+      'retry semantics, featurize workers, protocol version '
+      'negotiation (run_all_tests.sh fleet)',
+  )
 
 
 @pytest.fixture(scope='session')

@@ -1,0 +1,773 @@
+"""Fleet tier suite: `dctpu route` + disaggregated featurize workers.
+
+In-process router fronting stubbed (weightless) model replicas, so the
+balancing/retry/drain semantics run in milliseconds:
+
+  * protocol version negotiation — the features/1 compact frame and
+    the bam/1 raw frame, old-client/new-server and new-client/
+    old-server behavior, lossless-packing guards;
+  * registry health gating and the balancer's weighted least-loaded
+    pick with bounded in-flight;
+  * the ack-boundary retry semantics: send-phase failures and explicit
+    429/503 refusals move to another replica, post-send failures
+    surface as typed ReplicaLostError and are never placed twice;
+  * multi-replica byte identity vs a solo replica, and the
+    disaggregated bam/1 -> featurize worker -> model replica path vs
+    monolithic client-side featurize;
+  * runtime /v1/register joins and the rolling-restart drain flow.
+
+The real-subprocess rolling-restart acceptance demo lives in
+scripts/soak_e2e.py --fleet (scripts/run_resilience.sh --fleet).
+"""
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu.fleet import registry as registry_lib
+from deepconsensus_tpu.fleet import router as router_lib
+from deepconsensus_tpu.fleet.balancer import LeastLoadedBalancer
+from deepconsensus_tpu.fleet.featurize_worker import (
+    FeaturizeService,
+    FeaturizeWorkerOptions,
+    worker_main,
+)
+from deepconsensus_tpu.fleet.registry import (
+    FEATURIZE_TIER,
+    MODEL_TIER,
+    ReplicaRegistry,
+    ReplicaState,
+)
+from deepconsensus_tpu.inference import runner as runner_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.preprocess import (
+    FeatureLayout,
+    create_proc_feeder,
+    reads_to_pileup,
+)
+from deepconsensus_tpu.preprocess.pileup import row_indices
+from deepconsensus_tpu.serve import protocol
+from deepconsensus_tpu.serve import server as server_lib
+from deepconsensus_tpu.serve.client import ServeClient, ServeClientError
+from deepconsensus_tpu.serve.service import ConsensusService, ServeOptions
+
+pytestmark = [pytest.mark.fleet, pytest.mark.resilience]
+
+BATCH = 8
+STUB_QUAL = 40
+
+
+@pytest.fixture(scope='module')
+def params():
+  p = config_lib.get_config('transformer_learn_values+test')
+  config_lib.finalize_params(p, is_training=False)
+  return p
+
+
+def _stub_runner(params):
+  options = runner_lib.InferenceOptions(batch_size=BATCH)
+  options.max_passes = params.max_passes
+  options.max_length = params.max_length
+  options.use_ccs_bq = params.use_ccs_bq
+  runner = runner_lib.ModelRunner(params, {}, options)
+  mp = params.max_passes
+
+  def finalize(rows):
+    ids = rows[:, 4 * mp, :, 0].astype(np.int32)
+    return ids, np.full(ids.shape, STUB_QUAL, np.int32)
+
+  runner.dispatch = lambda rows: rows
+  runner.finalize = finalize
+  return runner, options
+
+
+def _mol(params, name, n=4, seed=0):
+  rng = np.random.default_rng(seed)
+  return dict(
+      name=name,
+      subreads=rng.integers(
+          0, 5, size=(n, params.total_rows, params.max_length, 1)
+      ).astype(np.float32),
+      window_pos=np.arange(n, dtype=np.int64) * params.max_length,
+      ccs_bq=np.full((n, params.max_length), 30, dtype=np.int32),
+      overflow=np.zeros(n, dtype=np.uint8),
+  )
+
+
+def _features(params, name, n=4, seed=0):
+  """_mol as per-window preprocess feature dicts (polish_features
+  input)."""
+  mol = _mol(params, name, n=n, seed=seed)
+  return [
+      dict(
+          name=name,
+          subreads=mol['subreads'][i],
+          window_pos=int(mol['window_pos'][i]),
+          ccs_base_quality_scores=mol['ccs_bq'][i],
+          overflow=bool(mol['overflow'][i]),
+      )
+      for i in range(n)
+  ]
+
+
+class _Fleet:
+  """One router + its replicas, all in-process."""
+
+  def __init__(self):
+    self.replicas = []      # (service, httpd, port)
+    self.workers = []       # (stop_event, thread, port)
+    self.router_stop = threading.Event()
+    self.router_thread = None
+    self.router_stats = {}
+    self.port = None
+
+  def client(self, timeout=30):
+    return ServeClient(port=self.port, timeout=timeout)
+
+
+@pytest.fixture()
+def fleet(params):
+  """Factory: fleet(n_replicas, n_workers, **router_options) builds an
+  in-process fleet and returns a _Fleet handle. Everything is torn
+  down at test end."""
+  made = []
+
+  def make_replica():
+    runner, options = _stub_runner(params)
+    service = ConsensusService(
+        runner, options, ServeOptions(io_timeout_s=5.0))
+    service.warmup()
+    service.start()
+    httpd = server_lib.build_server(service, '127.0.0.1', 0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return service, httpd, httpd.server_address[1]
+
+  def make_worker():
+    stop = threading.Event()
+    ready = {}
+    opts = FeaturizeWorkerOptions(
+        max_passes=params.max_passes, max_length=params.max_length)
+    t = threading.Thread(
+        target=lambda: worker_main(
+            opts, port=0, ready_fn=ready.update, stop_event=stop),
+        daemon=True)
+    t.start()
+    while 'port' not in ready:
+      time.sleep(0.01)
+    return stop, t, ready['port']
+
+  def make(n_replicas=2, n_workers=0, **router_overrides):
+    f = _Fleet()
+    for _ in range(n_replicas):
+      f.replicas.append(make_replica())
+    for _ in range(n_workers):
+      f.workers.append(make_worker())
+    opts = router_lib.RouterOptions(
+        probe_interval_s=0.1, probe_timeout_s=2.0, io_timeout_s=5.0,
+        **router_overrides)
+    ready = {}
+    f.router_thread = threading.Thread(
+        target=lambda: f.router_stats.update(router_lib.route_main(
+            [f'127.0.0.1:{p}' for _, _, p in f.replicas],
+            [f'127.0.0.1:{p}' for _, _, p in f.workers],
+            options=opts, port=0, ready_fn=ready.update,
+            stop_event=f.router_stop)),
+        daemon=True)
+    f.router_thread.start()
+    while 'port' not in ready:
+      time.sleep(0.01)
+    f.port = ready['port']
+    made.append(f)
+    return f
+
+  yield make
+  for f in made:
+    f.router_stop.set()
+    f.router_thread.join(timeout=15)
+    for stop, t, _ in f.workers:
+      stop.set()
+      t.join(timeout=10)
+    for service, httpd, _ in f.replicas:
+      service.begin_drain()
+      httpd.shutdown()
+      httpd.server_close()
+      service.drain(timeout=10)
+
+
+# ----------------------------------------------------------------------
+# Protocol version negotiation (features/1, bam/1, legacy)
+
+
+def _decode_kwargs(params):
+  return dict(total_rows=params.total_rows,
+              max_length=params.max_length, max_windows=512)
+
+
+def test_features_frame_roundtrips_byte_identical(params):
+  """A features/1 compact pack decodes to the exact arrays the legacy
+  float frame carries — the model replica cannot tell them apart."""
+  feats = _features(params, 'm/7/ccs', n=3, seed=7)
+  for fd in feats:
+    # Real pileups carry per-window-constant SN rows; the random _mol
+    # tensor doesn't, so pin them to make the pack eligible.
+    fd['subreads'][-4:] = np.arange(4, dtype=np.float32)[:, None, None]
+  legacy = protocol.request_from_features(feats)
+  compact = protocol.features_pack_from_features(feats)
+  assert compact is not None
+  assert len(compact) < len(legacy) // 2  # the point of the frame
+  ref = protocol.decode_request(legacy, **_decode_kwargs(params))
+  got = protocol.decode_request(compact, **_decode_kwargs(params))
+  assert got['name'] == ref['name']
+  for key in ('subreads', 'window_pos', 'ccs_bq', 'overflow'):
+    np.testing.assert_array_equal(got[key], ref[key], err_msg=key)
+
+
+@pytest.mark.parametrize('max_passes,use_ccs_bq', [
+    (2, False), (2, True), (20, False), (20, True), (5, True),
+])
+def test_bq_row_derivation_matches_layout(max_passes, use_ccs_bq):
+  """Both frame codecs derive the ccs_bq row from total_rows alone;
+  that derivation must match the canonical row layout for every
+  (max_passes, use_ccs_bq)."""
+  *_, ccs_bq_range, sn_range = row_indices(max_passes, use_ccs_bq)
+  total_rows = sn_range[1]
+  derived = protocol._bq_row_for_total_rows(total_rows)
+  if use_ccs_bq:
+    assert derived == ccs_bq_range[0]
+  else:
+    assert derived is None
+
+
+def test_lossless_guard_falls_back_to_legacy_frame(params):
+  """Values that don't pack losslessly into uint8 (pw > 255, or SN
+  rows that vary inside a window) make the compact encoder bow out
+  with None — the caller then ships the exact legacy float frame."""
+  feats = _features(params, 'm/8/ccs', n=2, seed=8)
+  mp = params.max_passes
+  feats[0]['subreads'][mp, 0, 0] = 300.0  # pre-clip pw overflows uint8
+  assert protocol.features_pack_from_features(feats) is None
+
+  feats = _features(params, 'm/9/ccs', n=2, seed=9)
+  feats[0]['subreads'][-1, 0, 0] = 1.0    # sn no longer constant
+  feats[0]['subreads'][-1, 1, 0] = 2.0
+  assert protocol.features_pack_from_features(feats) is None
+
+  feats = _features(params, 'm/10/ccs', n=2, seed=10)
+  feats[0]['subreads'][0, 0, 0] = 0.5     # non-integral value
+  assert protocol.features_pack_from_features(feats) is None
+
+
+def test_unknown_frame_is_typed_400_not_parse_crash(params):
+  """A client speaking a future frame version gets a typed 400 naming
+  the known frames, never an unhandled parse error."""
+  import io as _io
+  buf = _io.BytesIO()
+  np.savez(buf, frame=np.array('features/99'), payload=np.zeros(3))
+  with pytest.raises(shared_faults.BadRequestError) as e:
+    protocol.decode_request(buf.getvalue(), **_decode_kwargs(params))
+  for frame in protocol.KNOWN_FRAMES:
+    assert frame in str(e.value)
+
+
+def test_bam_frame_to_model_replica_is_typed_400(params):
+  """An old-topology deployment (client with a new frame, no router in
+  front) answers with a typed 400 pointing at the route tier."""
+  body = protocol.encode_bam_request(b'x' * 10, b'y' * 10, name='z/1')
+  with pytest.raises(shared_faults.BadRequestError, match='dctpu route'):
+    protocol.decode_request(body, **_decode_kwargs(params))
+
+
+def test_bam_frame_roundtrip_and_malformed_variants():
+  body = protocol.encode_bam_request(b'SUB', b'CCS', name='m/1/ccs')
+  assert protocol.sniff_frame(body) == protocol.FRAME_BAM
+  req = protocol.decode_bam_request(body)
+  assert req['subreads_bam'] == b'SUB'
+  assert req['ccs_bam'] == b'CCS'
+  assert req['name'] == 'm/1/ccs'
+
+  with pytest.raises(shared_faults.BadRequestError):
+    protocol.decode_bam_request(b'not an npz at all')
+  with pytest.raises(shared_faults.BadRequestError, match='empty'):
+    protocol.decode_bam_request(
+        protocol.encode_bam_request(b'', b'CCS'))
+  # A features/1 body is the wrong frame for a featurize worker.
+  feats_body = protocol.encode_request(
+      'm/1', np.zeros((1, 4, 8, 1), np.float32),
+      np.zeros(1, np.int64), np.zeros((1, 8), np.int32),
+      np.zeros(1, np.uint8))
+  with pytest.raises(shared_faults.BadRequestError):
+    protocol.decode_bam_request(feats_body)
+
+
+def test_legacy_frame_still_decodes(params):
+  """Old clients keep working against new servers: the frameless
+  legacy body is untouched by the version negotiation."""
+  feats = _features(params, 'm/11/ccs', n=2, seed=11)
+  legacy = protocol.request_from_features(feats)
+  assert protocol.sniff_frame(legacy) is None
+  out = protocol.decode_request(legacy, **_decode_kwargs(params))
+  assert out['name'] == 'm/11/ccs'
+
+
+# ----------------------------------------------------------------------
+# Registry + balancer semantics (no HTTP)
+
+
+def _ready_replica(reg, url, tier=MODEL_TIER, **attrs):
+  reg.add(url, tier=tier)
+  with reg.lock:
+    r = reg._replicas[url]
+    r.state = ReplicaState.READY
+    for k, v in attrs.items():
+      setattr(r, k, v)
+  return url
+
+
+def test_registry_health_gates_new_replicas():
+  """add() never yields a routable replica until a probe has seen
+  /readyz pass: JOINING replicas are invisible to the balancer."""
+  reg = ReplicaRegistry()
+  reg.add('127.0.0.1:1', tier=MODEL_TIER)
+  assert reg.snapshot()[0].state == ReplicaState.JOINING
+  balancer = LeastLoadedBalancer(reg)
+  with pytest.raises(shared_faults.FleetRejection, match='not.*ready|no model replica is ready'):
+    balancer.acquire(MODEL_TIER)
+
+
+def test_registry_rejects_unknown_tier():
+  reg = ReplicaRegistry()
+  with pytest.raises(ValueError, match='tier'):
+    reg.add('127.0.0.1:1', tier='gpu')
+
+
+def test_balancer_prefers_least_loaded_and_degraded_half_weight():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1', queue_depth=6)
+  _ready_replica(reg, 'b:1', queue_depth=0)
+  balancer = LeastLoadedBalancer(reg)
+  assert balancer.acquire(MODEL_TIER).url == 'b:1'
+  # b now carries 1 in-flight; a degraded replica with the same load
+  # scores twice as busy, so the pick still avoids it.
+  _ready_replica(reg, 'c:1', queue_depth=0, degraded=True)
+  picks = [balancer.acquire(MODEL_TIER).url for _ in range(2)]
+  assert picks.count('c:1') <= 1  # healthy replicas absorb more
+
+
+def test_balancer_bounded_inflight_saturates_with_typed_503():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1')
+  balancer = LeastLoadedBalancer(reg, max_inflight=2)
+  balancer.acquire(MODEL_TIER)
+  balancer.acquire(MODEL_TIER)
+  with pytest.raises(shared_faults.FleetRejection,
+                     match='in-flight bound') as e:
+    balancer.acquire(MODEL_TIER)
+  assert e.value.http_status == 503
+  assert e.value.kind == shared_faults.FaultKind.TRANSIENT
+  balancer.release('a:1', 'ok')
+  assert balancer.acquire(MODEL_TIER).url == 'a:1'
+
+
+def test_balancer_scales_bound_by_mesh_dp():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1', mesh_dp=4)
+  balancer = LeastLoadedBalancer(reg, max_inflight=2)
+  for _ in range(8):  # 2 * mesh_dp
+    balancer.acquire(MODEL_TIER)
+  with pytest.raises(shared_faults.FleetRejection):
+    balancer.acquire(MODEL_TIER)
+
+
+def test_draining_replica_gets_no_new_work():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1')
+  _ready_replica(reg, 'b:1')
+  reg.mark_draining('a:1')
+  balancer = LeastLoadedBalancer(reg)
+  assert all(
+      balancer.acquire(MODEL_TIER, exclude=()).url == 'b:1'
+      for _ in range(3))
+
+
+def test_registry_aggregates_replica_counters():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1', counters={'n_requests': 3, 'x_fraction': 0.5})
+  _ready_replica(reg, 'b:1', counters={'n_requests': 4, 'x_fraction': 1.0})
+  agg = reg.aggregate_counters()
+  assert agg['n_requests'] == 7
+  assert agg['x_fraction'] == pytest.approx(0.75)  # fractions average
+
+
+# ----------------------------------------------------------------------
+# Router integration (in-process HTTP fleet)
+
+
+def test_multi_replica_byte_identity_vs_solo(fleet, params):
+  """Concurrent clients through a 2-replica router each get exactly
+  the bytes a solo replica returns."""
+  f = fleet(n_replicas=2)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  solo = ServeClient(port=f.replicas[0][2], timeout=30)
+  mols = [_mol(params, f'm/{i}/ccs', n=2 + i % 3, seed=i)
+          for i in range(8)]
+  want = [solo.polish(**m) for m in mols]
+  got = [None] * len(mols)
+  errors = []
+
+  def worker(i):
+    try:
+      got[i] = ServeClient(port=f.port, timeout=30).polish(**mols[i])
+    except Exception as e:  # noqa: BLE001 — surfaced via assert below
+      errors.append(e)
+
+  threads = [threading.Thread(target=worker, args=(i,))
+             for i in range(len(mols))]
+  for t in threads:
+    t.start()
+  for t in threads:
+    t.join(30)
+  assert not errors
+  for i, (w, g) in enumerate(zip(want, got)):
+    assert g['status'] == 'ok', i
+    assert g['seq'] == w['seq'], i
+    np.testing.assert_array_equal(g['quals'], w['quals'])
+  # Both replicas actually served traffic.
+  m = rc.metricz()
+  served = [r for r in m['replicas'] if r['n_ok'] > 0]
+  assert len(served) == 2, m['replicas']
+
+
+def test_compact_features_through_router_byte_identical(fleet, params):
+  f = fleet(n_replicas=1)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  solo = ServeClient(port=f.replicas[0][2], timeout=30)
+  feats = _features(params, 'm/3/ccs', n=3, seed=3)
+  want = solo.polish_features(feats, compact=False)
+  got = rc.polish_features(feats, compact=True)
+  assert got['status'] == 'ok'
+  assert got['seq'] == want['seq']
+  np.testing.assert_array_equal(got['quals'], want['quals'])
+
+
+def test_disaggregated_bam_path_byte_identical_to_monolithic(
+    fleet, params, synthetic_bams):
+  """bam/1 -> router -> featurize worker -> model replica produces the
+  same polished bytes as featurizing client-side (monolithic path) and
+  posting the legacy frame straight to a replica."""
+  f = fleet(n_replicas=1, n_workers=1)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  sub_path, ccs_path = synthetic_bams(n_zmws=1, n_subreads=3, seq_len=120)
+
+  # Monolithic reference: featurize in-process, post to the replica.
+  layout = FeatureLayout(params.max_passes, params.max_length,
+                         params.use_ccs_bq)
+  feeder, _ = create_proc_feeder(
+      subreads_to_ccs=sub_path, ccs_bam=ccs_path, layout=layout)
+  mono = None
+  for zmw_input in feeder():
+    subreads, name, lo, _split, window_widths = zmw_input
+    mono = list(
+        reads_to_pileup(subreads, name, lo, window_widths)
+        .iter_window_features())
+  assert mono
+  solo = ServeClient(port=f.replicas[0][2], timeout=30)
+  want = solo.polish_body(protocol.request_from_features(mono))
+
+  with open(sub_path, 'rb') as fh:
+    subreads_bam = fh.read()
+  with open(ccs_path, 'rb') as fh:
+    ccs_bam = fh.read()
+  got = rc.polish_bam(subreads_bam, ccs_bam, name='z/1')
+  assert got['status'] == 'ok'
+  assert got['seq'] == want['seq']
+  np.testing.assert_array_equal(got['quals'], want['quals'])
+
+  m = rc.metricz()
+  assert m['router']['n_routed_featurize'] == 1
+  assert m['latency']['featurize']['n'] == 1
+
+
+def test_send_phase_failure_retries_on_another_replica(fleet, params):
+  """A replica that never reads the request (connection refused) is
+  transparently retried elsewhere and marked DEAD."""
+  f = fleet(n_replicas=2, max_attempts=3)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  # Kill replica 0 without letting the prober notice first.
+  service, httpd, port = f.replicas[0]
+  httpd.shutdown()
+  httpd.server_close()
+  service.begin_drain()
+  ok = sum(
+      rc.polish(**_mol(params, f'r/{i}/ccs'))['status'] == 'ok'
+      for i in range(4))
+  assert ok == 4
+  m = rc.metricz()
+  states = {r['url']: r['state'] for r in m['replicas']}
+  assert states[f'127.0.0.1:{port}'] == ReplicaState.DEAD
+
+
+def test_post_send_death_is_typed_503_and_never_duplicated(
+    fleet, params):
+  """A replica that dies after fully reading the request surfaces as a
+  typed 503 ReplicaLostError and the request is NOT re-placed: the
+  surviving replica sees zero new requests from it."""
+  f = fleet(n_replicas=1, max_attempts=3)
+  rc = f.client()
+  assert rc.wait_ready(10)
+
+  # An "evil" replica: reads the whole POST, then slams the socket.
+  evil = socket.socket()
+  evil.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+  evil.bind(('127.0.0.1', 0))
+  evil.listen(4)
+  evil_port = evil.getsockname()[1]
+
+  def evil_loop():
+    while True:
+      try:
+        conn, _ = evil.accept()
+      except OSError:
+        return
+      with conn:
+        data = b''
+        while b'\r\n\r\n' not in data:
+          chunk = conn.recv(65536)
+          if not chunk:
+            break
+          data += chunk
+        head, _, rest = data.partition(b'\r\n\r\n')
+        length = 0
+        for line in head.split(b'\r\n'):
+          if line.lower().startswith(b'content-length:'):
+            length = int(line.split(b':', 1)[1])
+        while len(rest) < length:
+          chunk = conn.recv(65536)
+          if not chunk:
+            break
+          rest += chunk
+        # Fully acked, then die: RST, no response bytes.
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack('ii', 1, 0))
+
+  threading.Thread(target=evil_loop, daemon=True).start()
+
+  # Drive RouterCore directly: the evil replica is hand-promoted to
+  # READY with a lower load than the healthy one, so the pick lands on
+  # it first.
+  registry = ReplicaRegistry()
+  _ready_replica(registry, f'127.0.0.1:{evil_port}', queue_depth=0)
+  healthy_port = f.replicas[0][2]
+  _ready_replica(registry, f'127.0.0.1:{healthy_port}', queue_depth=50)
+  core = router_lib.RouterCore(
+      registry, router_lib.RouterOptions(max_attempts=3,
+                                         upstream_timeout_s=10))
+  before = f.replicas[0][0].stats()['faults']['n_requests']
+  body = protocol.request_from_features(_features(params, 'd/1/ccs'))
+  with pytest.raises(shared_faults.ReplicaLostError) as e:
+    core.route(body)
+  assert e.value.http_status == 503
+  assert e.value.kind == shared_faults.FaultKind.TRANSIENT
+  assert 'never duplicated' in str(e.value)
+  after = f.replicas[0][0].stats()['faults']['n_requests']
+  assert after == before  # the healthy replica never saw the request
+  with registry.lock:
+    assert (registry._replicas[f'127.0.0.1:{evil_port}'].state
+            == ReplicaState.DEAD)
+  evil.close()
+
+
+def test_upstream_draining_503_moves_on_and_marks_draining(params):
+  """An explicit 503 naming a drain flips the replica to DRAINING
+  immediately (rolling-restart fast path) and the request succeeds on
+  the next replica."""
+  drain_payload = json.dumps(
+      {'error': 'UNAVAILABLE: draining', 'kind': 'transient'}).encode()
+  resp = (b'HTTP/1.1 503 Service Unavailable\r\n'
+          b'Content-Type: application/json\r\n'
+          + f'Content-Length: {len(drain_payload)}\r\n\r\n'.encode()
+          + drain_payload)
+
+  srv = socket.socket()
+  srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+  srv.bind(('127.0.0.1', 0))
+  srv.listen(4)
+  drain_port = srv.getsockname()[1]
+
+  def loop():
+    while True:
+      try:
+        conn, _ = srv.accept()
+      except OSError:
+        return
+      with conn:
+        data = b''
+        while b'\r\n\r\n' not in data:
+          chunk = conn.recv(65536)
+          if not chunk:
+            break
+          data += chunk
+        head, _, rest = data.partition(b'\r\n\r\n')
+        length = 0
+        for line in head.split(b'\r\n'):
+          if line.lower().startswith(b'content-length:'):
+            length = int(line.split(b':', 1)[1])
+        while len(rest) < length:
+          chunk = conn.recv(65536)
+          if not chunk:
+            break
+          rest += chunk
+        conn.sendall(resp)
+
+  threading.Thread(target=loop, daemon=True).start()
+
+  params_local = params
+  runner, options = _stub_runner(params_local)
+  service = ConsensusService(
+      runner, options, ServeOptions(io_timeout_s=5.0))
+  service.warmup()
+  service.start()
+  httpd = server_lib.build_server(service, '127.0.0.1', 0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  good_port = httpd.server_address[1]
+  try:
+    registry = ReplicaRegistry()
+    _ready_replica(registry, f'127.0.0.1:{drain_port}', queue_depth=0)
+    _ready_replica(registry, f'127.0.0.1:{good_port}', queue_depth=50)
+    core = router_lib.RouterCore(
+        registry, router_lib.RouterOptions(max_attempts=3,
+                                           upstream_timeout_s=10))
+    body = protocol.request_from_features(
+        _features(params_local, 'g/1/ccs'))
+    status, data, _ = core.route(body)
+    assert status == 200
+    out = protocol.decode_response(data)
+    assert out['status'] == 'ok'
+    with registry.lock:
+      assert (registry._replicas[f'127.0.0.1:{drain_port}'].state
+              == ReplicaState.DRAINING)
+    with core._lock:
+      assert core._counters['n_retries'] == 1
+  finally:
+    srv.close()
+    service.begin_drain()
+    httpd.shutdown()
+    httpd.server_close()
+    service.drain(timeout=10)
+
+
+def test_runtime_register_joins_health_gated(fleet, params):
+  """POST /v1/register adds a replica as JOINING; the prober promotes
+  it to READY and it starts taking traffic."""
+  f = fleet(n_replicas=1)
+  rc = f.client()
+  assert rc.wait_ready(10)
+
+  runner, options = _stub_runner(params)
+  service = ConsensusService(
+      runner, options, ServeOptions(io_timeout_s=5.0))
+  service.warmup()
+  service.start()
+  httpd = server_lib.build_server(service, '127.0.0.1', 0)
+  threading.Thread(target=httpd.serve_forever, daemon=True).start()
+  new_port = httpd.server_address[1]
+  try:
+    status, body, _ = rc._request(
+        'POST', '/v1/register',
+        body=json.dumps({'url': f'127.0.0.1:{new_port}',
+                         'tier': MODEL_TIER}).encode())
+    assert status == 200, body
+    assert json.loads(body)['state'] == ReplicaState.JOINING
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+      m = rc.metricz()
+      states = {r['url']: r['state'] for r in m['replicas']}
+      if states.get(f'127.0.0.1:{new_port}') == ReplicaState.READY:
+        break
+      time.sleep(0.05)
+    else:
+      pytest.fail(f'replica never became READY: {states}')
+    # Malformed register is a typed 400.
+    status, body, _ = rc._request('POST', '/v1/register', body=b'{}')
+    assert status == 400
+    status, body, _ = rc._request(
+        'POST', '/v1/register',
+        body=json.dumps({'url': 'x:1', 'tier': 'gpu'}).encode())
+    assert status == 400
+  finally:
+    service.begin_drain()
+    httpd.shutdown()
+    httpd.server_close()
+    service.drain(timeout=10)
+
+
+def test_router_drain_refuses_new_work_and_exits_clean(fleet, params):
+  f = fleet(n_replicas=1)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  assert rc.polish(**_mol(params, 'm/1/ccs'))['status'] == 'ok'
+  f.router_stop.set()
+  f.router_thread.join(timeout=15)
+  assert f.router_stats.get('drained') is True
+  assert f.router_stats['router']['n_requests'] == 1
+
+
+def test_fleet_down_is_typed_503_transient(fleet, params):
+  f = fleet(n_replicas=1, max_attempts=2)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  service, httpd, _ = f.replicas[0]
+  httpd.shutdown()
+  httpd.server_close()
+  service.begin_drain()
+  time.sleep(0.4)  # a probe cycle marks it dead
+  with pytest.raises(ServeClientError) as e:
+    rc.polish(**_mol(params, 'x/1/ccs'))
+  assert e.value.status == 503
+  assert e.value.kind == shared_faults.FaultKind.TRANSIENT
+  assert not rc.readyz().get('ready')
+
+
+def test_router_metricz_aggregates_fleet(fleet, params):
+  f = fleet(n_replicas=2)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  for i in range(4):
+    rc.polish(**_mol(params, f'm/{i}/ccs'))
+  time.sleep(0.3)  # let a probe refresh cached replica counters
+  m = rc.metricz()
+  assert m['router']['n_requests'] == 4
+  assert m['latency']['model']['n'] == 4
+  assert m['latency']['model']['p50_s'] is not None
+  assert m['latency']['model']['p99_s'] is not None
+  assert {r['tier'] for r in m['replicas']} == {MODEL_TIER}
+  assert m['fleet_counters'].get('n_requests', 0) == 4
+  for r in m['replicas']:
+    assert r['in_flight'] == 0
+    assert r['n_routed'] == r['n_ok']
+
+
+def test_featurize_worker_rejects_multi_molecule_and_garbage(
+    params, synthetic_bams):
+  svc = FeaturizeService(FeaturizeWorkerOptions(
+      max_passes=params.max_passes, max_length=params.max_length))
+  sub_path, ccs_path = synthetic_bams(n_zmws=2, n_subreads=3,
+                                      seq_len=120)
+  with open(sub_path, 'rb') as fh:
+    subreads_bam = fh.read()
+  with open(ccs_path, 'rb') as fh:
+    ccs_bam = fh.read()
+  with pytest.raises(shared_faults.BadRequestError,
+                     match='one request per ZMW'):
+    svc.featurize(protocol.encode_bam_request(subreads_bam, ccs_bam))
+  with pytest.raises(shared_faults.BadRequestError):
+    svc.featurize(protocol.encode_bam_request(b'garbage', b'junk'))
+  assert svc.stats()['faults']['n_bad_requests'] == 2

@@ -155,6 +155,10 @@ def reference_model_and_checkpoint(tmp_path_factory):
   _install_stubs(tf)
   if REFERENCE_ROOT not in sys.path:
     sys.path.insert(0, REFERENCE_ROOT)
+  pytest.importorskip(
+      'deepconsensus',
+      reason='reference deepconsensus checkout not present under '
+      f'{REFERENCE_ROOT}')
   from deepconsensus.models import model_configs as ref_configs
   from deepconsensus.models import networks as ref_networks
 

@@ -26,6 +26,7 @@ TYPED_FAULTS_SCOPE = (
     'deepconsensus_tpu/io/',
     'deepconsensus_tpu/inference/',
     'deepconsensus_tpu/serve/',
+    'deepconsensus_tpu/fleet/',
     'deepconsensus_tpu/models/data.py',
 )
 
@@ -49,6 +50,8 @@ FAULT_TYPES = frozenset({
     'DeviceOomError',
     'DeviceLostError',
     'DispatchTimeoutError',
+    'FleetRejection',
+    'ReplicaLostError',
     # deepconsensus_tpu/inference/faults.py
     'ZmwFault',
     'WatchdogTimeout',
@@ -177,6 +180,8 @@ GUARDED_BY_SCOPE = (
     'deepconsensus_tpu/serve/service.py',
     'deepconsensus_tpu/inference/engine.py',
     'deepconsensus_tpu/inference/runner.py',
+    'deepconsensus_tpu/fleet/registry.py',
+    'deepconsensus_tpu/fleet/router.py',
 )
 
 # Attribute initialisers of these types are synchronisation primitives
