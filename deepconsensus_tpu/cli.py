@@ -415,6 +415,16 @@ def _add_train(sub):
                  'the shard and keeps training.')
   p.add_argument('--tp', type=int, default=1,
                  help='Tensor-parallel mesh size.')
+  p.add_argument('--dp', type=int, default=None,
+                 help='Data-parallel mesh size (default: all devices '
+                 'not used by --tp).')
+  p.add_argument('--on_device_error', default='fail',
+                 choices=['fail', 'degrade'],
+                 help='Mid-training device fault policy: fail '
+                 'propagates (the retry wrapper restarts from the '
+                 'last checkpoint at full dp), degrade rebuilds the '
+                 'mesh one dp step down over the surviving devices, '
+                 're-places the live state, and keeps training.')
   p.add_argument('--coordinator_address',
                  help='host:port of process 0 (multi-host training).')
   p.add_argument('--num_processes', type=int,
@@ -502,6 +512,48 @@ def _add_distill(sub):
                  'as train --set; applied before finalize_params).')
 
 
+def _add_flywheel(sub):
+  p = sub.add_parser(
+      'flywheel',
+      help='Train -> distill -> quantization gates -> export, one '
+      'command: produces a servable baked artifact plus a manifest '
+      'recording every stage and gate result. A failed gate aborts '
+      'before export (exit 3).',
+  )
+  p.add_argument('--out_dir', required=True,
+                 help='Flywheel root; stages land in teacher/, '
+                 'student/, gates/, export/ plus flywheel_manifest.json.')
+  p.add_argument('--train_path', nargs='+', required=True)
+  p.add_argument('--eval_path', nargs='+', required=True)
+  p.add_argument('--config', default='transformer_learn_values+test',
+                 help='Teacher {model}+{dataset} preset.')
+  p.add_argument('--student_config',
+                 default='transformer_learn_values_distill+test',
+                 help='Student (distillation) preset.')
+  p.add_argument('--teacher_checkpoint', default=None,
+                 help='Existing teacher checkpoint: skip the training '
+                 'stage and spin the flywheel from here (the common '
+                 'retrain-student loop).')
+  p.add_argument('--num_epochs', type=int)
+  p.add_argument('--batch_size', type=int)
+  p.add_argument('--set', action='append', default=[], metavar='KEY=VALUE',
+                 dest='overrides',
+                 help='Teacher config override, repeatable.')
+  p.add_argument('--student_set', action='append', default=[],
+                 metavar='KEY=VALUE', dest='student_overrides',
+                 help='Student config override, repeatable.')
+  p.add_argument('--export_batch_size', type=int, default=1024)
+  p.add_argument('--int8_gate', type=float, default=None,
+                 help='Override the int8 alignment-identity delta gate '
+                 '(default 0.002, from the acceptance test).')
+  p.add_argument('--bf16_gate', type=int, default=None,
+                 help='Override the bf16 max per-base QV delta gate '
+                 '(default 3, from the acceptance test).')
+  p.add_argument('--tp', type=int, default=1,
+                 help='Tensor-parallel mesh size for train/distill.')
+  _add_quant_flags(p)
+
+
 def _add_calibrate(sub):
   p = sub.add_parser(
       'calibrate', help='Measure empirical base-quality calibration.')
@@ -559,6 +611,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_lint(sub)
   _add_train(sub)
   _add_distill(sub)
+  _add_flywheel(sub)
   _add_export(sub)
   _add_port(sub)
   _add_evaluate(sub)
@@ -899,6 +952,7 @@ def _dispatch(args) -> int:
         params.batch_size = args.batch_size
       if args.on_shard_error:
         params.on_shard_error = args.on_shard_error
+      params.on_device_error = args.on_device_error
     if (args.coordinator_address or args.num_processes
         or args.process_id is not None):
       # Initialize before the mesh is built so it spans all hosts
@@ -911,7 +965,14 @@ def _dispatch(args) -> int:
           num_processes=args.num_processes,
           process_id=args.process_id,
       )
-    mesh = mesh_lib.make_mesh(tp=args.tp)
+    if args.dp:
+      import jax
+
+      mesh = mesh_lib.make_mesh(
+          dp=args.dp, tp=args.tp,
+          devices=jax.devices()[:args.dp * args.tp])
+    else:
+      mesh = mesh_lib.make_mesh(tp=args.tp)
     train_lib.run_training_with_retry(
         params=params,
         out_dir=args.out_dir,
@@ -998,6 +1059,52 @@ def _dispatch(args) -> int:
         eval_patterns=args.eval_path,
         num_epochs=args.num_epochs,
     )
+    return 0
+
+  if args.command == 'flywheel':
+    import json
+
+    from deepconsensus_tpu import faults as faults_lib
+    from deepconsensus_tpu.models import flywheel as flywheel_lib
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.make_mesh(tp=args.tp) if args.tp > 1 else None
+    kwargs = {}
+    if args.int8_gate is not None:
+      kwargs['int8_gate_threshold'] = args.int8_gate
+    if args.bf16_gate is not None:
+      kwargs['bf16_gate_threshold'] = args.bf16_gate
+    try:
+      manifest = flywheel_lib.run_flywheel(
+          out_dir=args.out_dir,
+          train_patterns=args.train_path,
+          eval_patterns=args.eval_path,
+          teacher_config=args.config,
+          student_config=args.student_config,
+          teacher_checkpoint=args.teacher_checkpoint,
+          teacher_overrides=args.overrides,
+          student_overrides=args.student_overrides,
+          num_epochs=args.num_epochs,
+          batch_size=args.batch_size,
+          export_batch_size=args.export_batch_size,
+          inference_dtype=args.inference_dtype,
+          quantize_matmuls=args.quantize_matmuls,
+          mesh=mesh,
+          **kwargs,
+      )
+    except faults_lib.FlywheelGateError as e:
+      # The partial manifest (with the failing gate recorded) is
+      # already on disk; exit 3 distinguishes a gate veto from the
+      # operator-error exit 2.
+      print(f'dctpu: {e}', file=sys.stderr)
+      return 3
+    print(json.dumps({
+        'artifact': manifest['stages']['export']['artifact'],
+        'manifest': f'{args.out_dir}/{flywheel_lib.MANIFEST_NAME}',
+        'gates': [{k: g[k] for k in ('name', 'measured', 'threshold',
+                                     'passed')}
+                  for g in manifest['gates']],
+    }, indent=2))
     return 0
 
   if args.command == 'calibrate':

@@ -185,6 +185,37 @@ class NonFiniteTrainingError(RuntimeError):
   instead of looping on a diverged model."""
 
 
+class BucketedTrainingError(ValueError):
+  """`dctpu train` was handed a multi-bucket window config. Training
+  fixes ONE window shape (the jitted step compiles for a single
+  [B, R, L, 1] geometry); variable-length buckets are an inference
+  lever (PR 12's ragged dispatch). Raised at config time with the
+  actionable remedy instead of failing later with an opaque shape
+  mismatch inside the jitted step. Operator error: exit code 2."""
+
+
+class FlywheelGateError(RuntimeError):
+  """A `dctpu flywheel` accuracy gate failed: the quantized student
+  (int8 identity delta, bf16 per-base QV delta) regressed past the
+  documented threshold, so the pipeline refuses to export a servable
+  artifact from it. Permanent by construction (no transient markers):
+  re-running the same flywheel cannot pass the same gate.
+
+  Carries the machine-readable gate verdict so the manifest writer and
+  tests never parse the message."""
+
+  def __init__(self, gate: str, measured: float, threshold: float,
+               detail: str = ''):
+    msg = (f'flywheel gate {gate!r} failed: measured {measured:.6g} '
+           f'exceeds threshold {threshold:.6g}')
+    if detail:
+      msg = f'{msg} ({detail})'
+    super().__init__(msg)
+    self.gate = gate
+    self.measured = measured
+    self.threshold = threshold
+
+
 class ExportedArtifactMismatchError(ValueError):
   """An exported StableHLO artifact cannot serve the requested topology
   (fixed-batch artifact under a --dp mesh, or any mesh with a model
@@ -379,6 +410,11 @@ ENV_DEVICE_OOM_AT_PACK = 'DCTPU_FAULT_DEVICE_OOM_AT_PACK'
 ENV_DEVICE_LOST_AT_PACK = 'DCTPU_FAULT_DEVICE_LOST_AT_PACK'
 ENV_DEVICE_HANG_AT_PACK = 'DCTPU_FAULT_DEVICE_HANG_AT_PACK'
 ENV_DEVICE_HANG_S = 'DCTPU_FAULT_DEVICE_HANG_S'
+# Training analog of LOST_AT_PACK: raise a halted-device error inside
+# the Nth train step's dispatch (1-based; fires once per process) so
+# `dctpu train --on_device_error=degrade` must rebuild the mesh one dp
+# step down mid-run.
+ENV_DEVICE_LOST_AT_STEP = 'DCTPU_FAULT_DEVICE_LOST_AT_STEP'
 
 # Hooks that already fired in this process (consume-once semantics:
 # after a NaN-sentinel rollback the training loop passes the same step
@@ -486,6 +522,17 @@ def injected_device_fault(pack_ordinal: int) -> None:
     log.warning('fault injection: device lost at pack %d', pack_ordinal)
     raise DeviceLostError(
         f'injected halted device at pack {pack_ordinal}')
+
+
+def injected_train_device_fault(step: int) -> None:
+  """Raises a synthetic halted-device fault when ENV_DEVICE_LOST_AT_STEP
+  targets this training step (1-based; fires once per process). Called
+  from inside the train-step dispatch so the error surfaces exactly
+  where a real XlaRuntimeError would — under the degradation ladder's
+  classify/rebuild handler."""
+  if _fire_once(ENV_DEVICE_LOST_AT_STEP, step):
+    log.warning('fault injection: device lost at train step %d', step)
+    raise DeviceLostError(f'injected halted device at train step {step}')
 
 
 def injected_device_hang(pack_ordinal: int) -> float:

@@ -45,6 +45,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ENV_DEVICE_HANG_AT_PACK,
     ENV_DEVICE_HANG_S,
     ENV_DEVICE_LOST_AT_PACK,
+    ENV_DEVICE_LOST_AT_STEP,
     ENV_DEVICE_OOM_AT_PACK,
     ENV_KILL_SHARD_READER,
     ENV_KILL_TOKEN,
@@ -58,6 +59,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     _TRANSIENT_MARKERS,
     BackpressureError,
     BadRequestError,
+    BucketedTrainingError,
     CorruptInputError,
     CrashLoopError,
     DeadLetterWriter,
@@ -70,6 +72,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ExportedArtifactMismatchError,
     FaultKind,
     FleetRejection,
+    FlywheelGateError,
     NonFiniteTrainingError,
     ReplicaLostError,
     RequestTooLargeError,
@@ -79,6 +82,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     injected_crash_after_batches,
     injected_device_fault,
     injected_device_hang,
+    injected_train_device_fault,
     maybe_kill_shard_reader,
     maybe_kill_train_at_step,
     maybe_kill_worker,

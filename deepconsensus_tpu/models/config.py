@@ -45,6 +45,10 @@ def normalize_window_buckets(buckets, max_length: int):
   """
   if not buckets:
     return (int(max_length),)
+  if isinstance(buckets, str):
+    # '--set window_buckets=100,200' reaches here as the raw string;
+    # accept the same comma form as the dedicated CLI flag.
+    buckets = [b for b in buckets.replace(',', ' ').split()]
   out = tuple(int(b) for b in buckets)
   if any(b <= 0 for b in out):
     raise ValueError(f'window_buckets must be positive ints, got {out}')

@@ -19,6 +19,7 @@ from deepconsensus_tpu.models import losses as losses_lib
 from deepconsensus_tpu.models import metrics as metrics_lib
 from deepconsensus_tpu.models import model as model_lib
 from deepconsensus_tpu.models import train as train_lib
+from deepconsensus_tpu.parallel import partition_rules
 
 
 def init_student_from_teacher(
@@ -137,11 +138,23 @@ def run_distillation(
         'accuracy_total': total,
     }
 
-  train_step = jax.jit(step, donate_argnums=(0,))
+  # Same declarative rule table as run_training: the student state
+  # (params + LAMB moments) shards by partition_rules.DEFAULT_RULES and
+  # the batch over the data axis, so distillation scales on the same
+  # meshes as training without its own sharding map.
+  state_sh = trainer.state_shardings(state)
+  batch_sh = trainer._batch_sharding()
+  train_step = partition_rules.compile_parallel(
+      step,
+      in_shardings=(state_sh, {'rows': batch_sh, 'label': batch_sh}),
+      out_shardings=(state_sh, None),
+      donate_argnums=(0,),
+  )
 
   step_count = 0
   for _ in range(num_epochs):
     for batch in train_ds.epoch():
+      batch.pop('name', None)
       state, m = train_step(state, batch)
       step_count += 1
       if step_count % params.get('log_every_n_steps', 100) == 0:

@@ -1,0 +1,294 @@
+"""The dctpu flywheel: train -> distill -> quant gates -> export.
+
+One command that turns training data into a servable artifact, with
+the quantization acceptance gates from tests/test_quantized_inference
+enforced AT RUNTIME between distillation and export:
+
+  * int8 gate — held-out alignment identity within 0.002 of the f32
+    baseline (models/evaluate.run_evaluation on both variants);
+  * bf16 gate — per-base quality values within 3 QV of f32 on
+    positions where both precisions call the same base (the FASTQ
+    delta gate, computed from softmax probabilities via the host
+    epilogue oracle ops/output_plane.host_quality_reference).
+
+A failed gate raises faults.FlywheelGateError BEFORE export_model runs
+— an artifact that would serve degraded consensus is never written.
+Every stage and gate lands in flywheel_manifest.json next to the
+artifact, so `dctpu serve`'s baked-lever mismatch checks have a
+provenance record to point at.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import ml_collections
+import numpy as np
+
+from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu.calibration import lib as calibration_lib
+from deepconsensus_tpu.models import checkpoints as checkpoints_lib
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import data as data_lib
+from deepconsensus_tpu.models import distill as distill_lib
+from deepconsensus_tpu.models import evaluate as evaluate_lib
+from deepconsensus_tpu.models import export as export_lib
+from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.models import quantize as quantize_lib
+from deepconsensus_tpu.models import train as train_lib
+from deepconsensus_tpu.ops import output_plane
+
+MANIFEST_NAME = 'flywheel_manifest.json'
+
+# Gate thresholds mirror the acceptance tests; keep in sync with
+# tests/test_quantized_inference.py (0.002 identity, MAX_QV_DELTA=3).
+INT8_IDENTITY_GATE = 0.002
+BF16_QV_GATE = 3
+
+
+def _with_levers(params: ml_collections.ConfigDict,
+                 inference_dtype: Optional[str] = None,
+                 quantize_matmuls: Optional[str] = None):
+  """Copy of params with the quantization levers folded in (the
+  config-side half of runner._apply_quant_levers)."""
+  p = ml_collections.ConfigDict(params.to_dict())
+  with p.unlocked():
+    if inference_dtype:
+      p.inference_dtype = inference_dtype
+      p.dtype = inference_dtype
+    if quantize_matmuls and quantize_matmuls != 'none':
+      p.quantize_matmuls = quantize_matmuls
+  return p
+
+
+def _eval_identity(params, variables, eval_patterns, out_dir) -> float:
+  metrics = evaluate_lib.run_evaluation(
+      params=params, checkpoint_path=None, eval_patterns=eval_patterns,
+      out_dir=out_dir, variables=variables)
+  return float(metrics['alignment_identity'])
+
+
+def int8_identity_gate(params, variables, eval_patterns, out_dir,
+                       threshold: float = INT8_IDENTITY_GATE) -> Dict:
+  """|alignment_identity(int8) - alignment_identity(f32)| <= threshold."""
+  base = _eval_identity(params, variables, eval_patterns,
+                        os.path.join(out_dir, 'gate_f32'))
+  params_q = _with_levers(params, quantize_matmuls='int8')
+  variables_q, n_quantized = quantize_lib.prepare_inference_variables(
+      variables, params_q)
+  quant = _eval_identity(params_q, variables_q, eval_patterns,
+                         os.path.join(out_dir, 'gate_int8'))
+  measured = abs(quant - base)
+  return {
+      'name': 'int8_alignment_identity_delta',
+      'threshold': threshold,
+      'measured': round(measured, 6),
+      'passed': measured <= threshold,
+      'detail': {'f32_identity': round(base, 6),
+                 'int8_identity': round(quant, 6),
+                 'n_quantized_matmuls': int(n_quantized)},
+  }
+
+
+def bf16_qv_gate(params, variables, eval_patterns,
+                 threshold: int = BF16_QV_GATE,
+                 max_batches: int = 4,
+                 max_base_quality: int = 93) -> Dict:
+  """Max per-base QV delta between f32 and bf16 forwards <= threshold.
+
+  QVs come from the host epilogue oracle on each precision's softmax
+  max-probability; only positions where both precisions argmax to the
+  SAME base are compared (near-tie argmax flips change the base, not
+  the confidence — the FASTQ gate excludes them the same way).
+  """
+  cal = calibration_lib.parse_calibration_string('skip')
+  model_f32 = model_lib.get_model(params)
+  params_16 = _with_levers(params, inference_dtype='bfloat16')
+  model_16 = model_lib.get_model(params_16)
+  variables_16, _ = quantize_lib.prepare_inference_variables(
+      variables, params_16)
+  ds = data_lib.DatasetIterator(
+      patterns=list(eval_patterns), params=params,
+      batch_size=params.batch_size, shuffle=False)
+  fwd32 = jax.jit(lambda v, rows: model_f32.apply(v, rows))
+  fwd16 = jax.jit(lambda v, rows: model_16.apply(v, rows))
+  max_delta = 0
+  n_compared = 0
+  for i, batch in enumerate(ds.epoch()):
+    if i >= max_batches:
+      break
+    rows = batch['rows']
+    preds32 = np.asarray(fwd32(variables, rows), np.float32)
+    preds16 = np.asarray(fwd16(variables_16, rows), np.float32)
+    agree = preds32.argmax(-1) == preds16.argmax(-1)
+    q32 = output_plane.host_quality_reference(
+        preds32.max(-1), cal, max_base_quality)
+    q16 = output_plane.host_quality_reference(
+        preds16.max(-1), cal, max_base_quality)
+    if agree.any():
+      delta = np.abs(q32.astype(int) - q16.astype(int))[agree]
+      max_delta = max(max_delta, int(delta.max()))
+      n_compared += int(agree.sum())
+  return {
+      'name': 'bf16_max_qv_delta',
+      'threshold': threshold,
+      'measured': max_delta,
+      'passed': max_delta <= threshold,
+      'detail': {'n_positions_compared': n_compared},
+  }
+
+
+def _enforce(gates: Sequence[Dict]) -> None:
+  for gate in gates:
+    if not gate['passed']:
+      raise faults_lib.FlywheelGateError(
+          gate['name'], gate['measured'], gate['threshold'],
+          detail=json.dumps(gate.get('detail', {})))
+
+
+def run_flywheel(
+    out_dir: str,
+    train_patterns: Sequence[str],
+    eval_patterns: Sequence[str],
+    teacher_config: str = 'transformer_learn_values+test',
+    student_config: str = 'transformer_learn_values_distill+test',
+    teacher_checkpoint: Optional[str] = None,
+    teacher_overrides: Sequence[str] = (),
+    student_overrides: Sequence[str] = (),
+    num_epochs: Optional[int] = None,
+    batch_size: Optional[int] = None,
+    export_batch_size: int = 1024,
+    inference_dtype: Optional[str] = None,
+    quantize_matmuls: Optional[str] = None,
+    int8_gate_threshold: float = INT8_IDENTITY_GATE,
+    bf16_gate_threshold: int = BF16_QV_GATE,
+    mesh=None,
+) -> Dict:
+  """Train -> distill -> gates -> export; returns the manifest dict.
+
+  With teacher_checkpoint the training stage is skipped and the
+  flywheel spins from an existing teacher (the common retrain-student
+  loop). inference_dtype / quantize_matmuls choose the levers BAKED
+  into the exported artifact; both gates run and are enforced
+  regardless, so the manifest always records the full quantization
+  safety envelope of the released weights.
+  """
+  from deepconsensus_tpu import cli as cli_lib
+
+  os.makedirs(out_dir, exist_ok=True)
+  manifest: Dict = {'stages': {}, 'gates': [], 'ok': False}
+
+  # ---- stage 1: teacher ----------------------------------------------
+  if teacher_checkpoint is None:
+    teacher_params = config_lib.get_config(teacher_config)
+    cli_lib._apply_overrides(teacher_params, list(teacher_overrides))
+    config_lib.finalize_params(teacher_params)
+    with teacher_params.unlocked():
+      if batch_size:
+        teacher_params.batch_size = batch_size
+    teacher_dir = os.path.join(out_dir, 'teacher')
+    train_metrics = train_lib.run_training_with_retry(
+        params=teacher_params,
+        out_dir=teacher_dir,
+        train_patterns=list(train_patterns),
+        eval_patterns=list(eval_patterns),
+        num_epochs=num_epochs,
+        mesh=mesh,
+    )
+    teacher_checkpoint = checkpoints_lib.latest_valid_checkpoint(
+        os.path.join(teacher_dir, 'checkpoints'))
+    if teacher_checkpoint is None:
+      raise faults_lib.FlywheelGateError(
+          'teacher_training', 'no valid checkpoint', 'one checkpoint',
+          detail=f'training under {teacher_dir} left no valid checkpoint')
+    manifest['stages']['train'] = {
+        'checkpoint': teacher_checkpoint,
+        'metrics': {k: float(v) for k, v in train_metrics.items()},
+    }
+  else:
+    teacher_params = config_lib.read_params_from_json(teacher_checkpoint)
+    config_lib.finalize_params(teacher_params)
+    manifest['stages']['train'] = {
+        'checkpoint': teacher_checkpoint, 'skipped': True,
+    }
+  teacher_weights = checkpoints_lib.load_params(teacher_checkpoint)
+
+  # ---- stage 2: distill ----------------------------------------------
+  student_params = config_lib.get_config(student_config)
+  cli_lib._apply_overrides(student_params, list(student_overrides))
+  config_lib.finalize_params(student_params)
+  with student_params.unlocked():
+    if batch_size:
+      student_params.batch_size = batch_size
+  student_dir = os.path.join(out_dir, 'student')
+  distill_metrics = distill_lib.run_distillation(
+      params=student_params,
+      teacher_params_cfg=teacher_params,
+      teacher_variables={'params': teacher_weights},
+      out_dir=student_dir,
+      train_patterns=list(train_patterns),
+      eval_patterns=list(eval_patterns),
+      num_epochs=num_epochs,
+      mesh=mesh,
+  )
+  student_checkpoint = checkpoints_lib.latest_valid_checkpoint(
+      os.path.join(student_dir, 'checkpoints'))
+  if student_checkpoint is None:
+    raise faults_lib.FlywheelGateError(
+        'distillation', 'no valid checkpoint', 'one checkpoint',
+        detail=f'distillation under {student_dir} left no valid checkpoint')
+  manifest['stages']['distill'] = {
+      'checkpoint': student_checkpoint,
+      'metrics': {k: float(v) for k, v in distill_metrics.items()},
+  }
+  student_variables = {'params': checkpoints_lib.load_params(
+      student_checkpoint)}
+
+  # ---- stage 3: quantization gates -----------------------------------
+  gates_dir = os.path.join(out_dir, 'gates')
+  gates: List[Dict] = [
+      int8_identity_gate(student_params, student_variables,
+                         list(eval_patterns), gates_dir,
+                         threshold=int8_gate_threshold),
+      bf16_qv_gate(student_params, student_variables,
+                   list(eval_patterns), threshold=bf16_gate_threshold),
+  ]
+  manifest['gates'] = gates
+  # Manifest lands even on a failed gate: the failure itself is the
+  # record the next flywheel turn starts from.
+  _write_manifest(out_dir, manifest)
+  _enforce(gates)
+
+  # ---- stage 4: export -----------------------------------------------
+  export_dir = os.path.join(out_dir, 'export')
+  artifact = export_lib.export_model(
+      checkpoint_path=student_checkpoint,
+      out_dir=export_dir,
+      batch_size=export_batch_size,
+      variables=student_variables,
+      params=student_params,
+      inference_dtype=inference_dtype,
+      quantize_matmuls=quantize_matmuls,
+  )
+  manifest['stages']['export'] = {
+      'artifact': artifact,
+      'baked_levers': {
+          'inference_dtype': inference_dtype or 'float32',
+          'quantize_matmuls': quantize_matmuls or 'none',
+      },
+  }
+  manifest['ok'] = all(g['passed'] for g in gates)
+  _write_manifest(out_dir, manifest)
+  return manifest
+
+
+def _write_manifest(out_dir: str, manifest: Dict) -> str:
+  path = os.path.join(out_dir, MANIFEST_NAME)
+  tmp = path + '.tmp'
+  with open(tmp, 'w') as f:
+    json.dump(manifest, f, indent=2, sort_keys=True)
+    f.write('\n')
+  os.replace(tmp, path)
+  return path

@@ -15,6 +15,7 @@ import hashlib
 import json
 import logging
 import os
+import queue as queue_lib
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -37,6 +38,7 @@ from deepconsensus_tpu.models import losses as losses_lib
 from deepconsensus_tpu.models import metrics as metrics_lib
 from deepconsensus_tpu.models import model as model_lib
 from deepconsensus_tpu.parallel import mesh as mesh_lib
+from deepconsensus_tpu.parallel import partition_rules
 from deepconsensus_tpu.preprocess.pileup import row_indices
 
 
@@ -157,6 +159,21 @@ class Trainer:
   mesh: Optional[Any] = None
 
   def __post_init__(self):
+    # Training fixes ONE window shape: the jitted step compiles for a
+    # single [B, R, L, 1] geometry, while window_buckets is the PR-12
+    # ragged-dispatch inference lever. Reject at construction with the
+    # remedy instead of failing later with an opaque XLA shape error.
+    buckets = config_lib.resolve_window_buckets(self.params)
+    if len(buckets) > 1:
+      raise faults_lib.BucketedTrainingError(
+          f'training fixes one window shape but window_buckets='
+          f'{tuple(buckets)} requests variable-length buckets. Buckets '
+          'are an inference lever (`dctpu run/serve --window_buckets`); '
+          'drop window_buckets from the training config and train at '
+          f'max_length={int(self.params.max_length)}. Bucketed/long-'
+          'insert TRAINING is tracked as ROADMAP item 1 (long-insert '
+          'workloads on top of bucketed windows).'
+      )
     os.makedirs(self.out_dir, exist_ok=True)
     enable_compilation_cache()
     self.model = model_lib.get_model(self.params)
@@ -214,14 +231,20 @@ class Trainer:
     )
     with open(os.path.join(self.out_dir, 'model_summary.txt'), 'w') as f:
       f.write(model_lib.summarize_params(variables['params']))
-    # Place parameters according to the mesh sharding rules; optimizer
-    # state follows the parameter shardings on first update.
-    shardings = mesh_lib.param_shardings(self.mesh, state.params)
-    params_sharded = jax.device_put(state.params, shardings)
-    return state.replace(params=params_sharded)
+    # Place the WHOLE state by the declarative rule table: the LAMB
+    # moments mirror the param tree, so one re.search pass shards them
+    # exactly like their parameters (partition_rules.py), and scalars
+    # (step counts, schedule state) replicate.
+    return jax.device_put(state, self.state_shardings(state))
+
+  def state_shardings(self, state):
+    """Rule-table NamedShardings for a full TrainState (params,
+    optimizer moments, model_state, rng, scalars) on this mesh — the
+    single source train/eval/distill pjit steps compile against."""
+    return partition_rules.tree_shardings(self.mesh, state)
 
   # ---- steps ---------------------------------------------------------
-  def train_step_fn(self):
+  def train_step_fn(self, state: Optional[TrainState] = None):
     loss_obj = self.loss_fn
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
@@ -264,9 +287,16 @@ class Trainer:
       return new_state, metrics
 
     batch_sh = self._batch_sharding()
-    return jax.jit(
+    # With a concrete state the step is an explicit-sharding pjit: the
+    # donated input state and the returned state both carry the rule-
+    # table shardings, so XLA keeps every optimizer update in place
+    # (no gather/scatter around the step). Without one (legacy/bench
+    # callers) the state sharding is inferred from the arguments.
+    state_sh = None if state is None else self.state_shardings(state)
+    return partition_rules.compile_parallel(
         step,
-        in_shardings=(None, {'rows': batch_sh, 'label': batch_sh}),
+        in_shardings=(state_sh, {'rows': batch_sh, 'label': batch_sh}),
+        out_shardings=(state_sh, None),
         donate_argnums=(0,),
     )
 
@@ -301,7 +331,7 @@ class Trainer:
         for k, v in batch.items()
     }
 
-  def eval_step_fn(self):
+  def eval_step_fn(self, state: Optional[TrainState] = None):
     loss_obj = self.loss_fn
     params_cfg = self.params
     metric = self.alignment_metric
@@ -334,8 +364,10 @@ class Trainer:
       return out
 
     batch_sh = self._batch_sharding()
-    return jax.jit(
-        step, in_shardings=(None, {'rows': batch_sh, 'label': batch_sh})
+    state_sh = None if state is None else self.state_shardings(state)
+    return partition_rules.compile_parallel(
+        step,
+        in_shardings=(state_sh, {'rows': batch_sh, 'label': batch_sh}),
     )
 
   def run_eval(self, state, eval_ds) -> Dict[str, float]:
@@ -345,7 +377,7 @@ class Trainer:
     their TSVs carry the same metric key set and
     params.best_checkpoint_metric means the same thing everywhere."""
     if getattr(self, '_cached_eval_step', None) is None:
-      self._cached_eval_step = self.eval_step_fn()
+      self._cached_eval_step = self.eval_step_fn(state)
     eval_step = self._cached_eval_step
     sums: Dict[str, float] = {}
     batches = 0
@@ -522,6 +554,178 @@ class Trainer:
         except (TypeError, ValueError):
           continue
       writer.flush()
+
+
+class _PrefetchedBatch:
+  """One in-flight training batch: host arrays (kept for the NaN
+  sentinel and for re-placement after a mesh degrade), the async
+  device transfer, and the mesh generation the transfer targeted."""
+
+  __slots__ = ('names', 'host', 'device', 'generation', 'error')
+
+  def __init__(self):
+    self.names = None
+    self.host = None
+    self.device = None
+    self.generation = 0
+    self.error: Optional[BaseException] = None
+
+
+class TrainBatchPrefetcher:
+  """Double-buffered training-batch transfer: the PR-8 dispatch
+  pattern applied to input.
+
+  A producer thread pulls host batches (already host-prefetched by
+  data.prefetch_iterator), applies the batch fault-injection hooks,
+  and issues batch N+1's ASYNC sharded jax.device_put while the device
+  runs step N — jax.device_put returns before the copy completes, so
+  the H2D transfer rides under compute instead of serializing in the
+  jitted call's argument placement. The queue holds one ready handle
+  and the consumer holds another: depth-2 double buffering, same as
+  the inference dispatch pipeline.
+
+  Counters (surfaced in the metrics sidecar's `faults` split):
+  `n_batches_prefetched` counts launches issued while an earlier
+  batch's step was in flight (every launch after the first — the
+  depth-1 queue guarantees launch k happens only after the consumer
+  took batch k-1, i.e. during step k-1's async window);
+  `train_transfer_overlap_fraction` is that count over all launches,
+  so a clean run reports (steps-1)/steps.
+
+  Mesh degrades retarget the prefetcher: `retarget()` bumps the mesh
+  generation, and a handle whose transfer targeted a retired mesh is
+  re-placed from its host copy at consumption time.
+  """
+
+  def __init__(self, batches, trainer: Trainer, poison_base_step: int = 0):
+    self._trainer = trainer
+    self._batches = batches
+    self._poison_base = poison_base_step
+    self._lock = threading.Lock()
+    self._generation = 0  # guarded by: self._lock
+    self._n_launched = 0  # guarded by: self._lock
+    self._n_overlapped = 0  # guarded by: self._lock
+    self._n_replaced = 0  # guarded by: self._lock
+    self._stop = threading.Event()
+    self._queue: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+    self._thread = threading.Thread(
+        target=self._produce, daemon=True, name='train-batch-prefetch'
+    )
+    self._thread.start()
+
+  # ---- producer thread ----------------------------------------------
+  def _produce(self):
+    ordinal = self._poison_base
+    try:
+      for batch in self._batches:
+        if self._stop.is_set():
+          break
+        item = _PrefetchedBatch()
+        item.names = batch.pop('name', None)
+        ordinal += 1
+        # Injection ordinal = the step this batch is consumed at on the
+        # no-rollback path (rollbacks replay step numbers but never
+        # batches; _fire_once keeps hooks consume-once either way).
+        faults_lib.maybe_poison_batch(ordinal, batch)
+        item.host = dict(batch)
+        item.generation, item.device = self._launch(item.host)
+        if not self._put(item):
+          break
+    # dclint-style routing: the error crosses threads via the handle
+    # and re-raises at the consumer, like data.prefetch_iterator.
+    except BaseException as e:  # pylint: disable=broad-except
+      item = _PrefetchedBatch()
+      item.error = e
+      self._put(item)
+    else:
+      self._put(None)
+    finally:
+      close = getattr(self._batches, 'close', None)
+      if close is not None:
+        try:
+          close()
+        except Exception:  # pragma: no cover - best-effort shutdown
+          pass
+
+  def _launch(self, host: Dict[str, np.ndarray]):
+    """Issues the async sharded H2D transfer for one host batch and
+    returns (mesh generation, device arrays)."""
+    gbatch = self._trainer.globalize_batch(dict(host))
+    sh = self._trainer._batch_sharding()
+    with self._lock:
+      gen = self._generation
+      self._n_launched += 1
+      if self._n_launched > 1:
+        self._n_overlapped += 1
+    return gen, jax.device_put(gbatch, {k: sh for k in gbatch})
+
+  def _put(self, item) -> bool:
+    while not self._stop.is_set():
+      try:
+        self._queue.put(item, timeout=0.1)
+        return True
+      except queue_lib.Full:
+        continue
+    return False
+
+  # ---- consumer (training loop) -------------------------------------
+  def __iter__(self):
+    return self
+
+  def __next__(self):
+    item = self._queue.get()
+    if item is None:
+      raise StopIteration
+    if item.error is not None:
+      raise item.error
+    with self._lock:
+      gen = self._generation
+    if item.generation != gen:
+      # The transfer targeted a mesh that has since been degraded;
+      # re-place from the host copy onto the current mesh.
+      item.device = self.place(item.host)
+      item.generation = gen
+    return item.names, item.host, item.device
+
+  def place(self, host: Dict[str, np.ndarray]):
+    """Direct (non-overlapped) placement of a host batch on the
+    CURRENT mesh — used to re-dispatch the failed batch after a
+    degrade and to refresh stale prefetched transfers."""
+    gbatch = self._trainer.globalize_batch(dict(host))
+    sh = self._trainer._batch_sharding()
+    with self._lock:
+      self._n_replaced += 1
+    return jax.device_put(gbatch, {k: sh for k in gbatch})
+
+  def retarget(self) -> None:
+    """Invalidates in-flight transfers after a mesh rebuild: bumps the
+    generation so stale handles re-place at consumption."""
+    with self._lock:
+      self._generation += 1
+
+  def stats(self) -> Dict[str, float]:
+    with self._lock:
+      launched = self._n_launched
+      overlapped = self._n_overlapped
+      replaced = self._n_replaced
+    return {
+        'n_batch_launches': float(launched),
+        'n_batches_prefetched': float(overlapped),
+        'n_batches_replaced': float(replaced),
+        'train_transfer_overlap_fraction': (
+            round(overlapped / launched, 4) if launched else 0.0
+        ),
+    }
+
+  def close(self) -> None:
+    self._stop.set()
+    # Drain so a producer blocked in _put can observe the stop flag.
+    try:
+      while True:
+        self._queue.get_nowait()
+    except queue_lib.Empty:
+      pass
+    self._thread.join(timeout=5.0)
 
 
 class PreemptionGuard:
@@ -754,7 +958,6 @@ def run_training(
     # checkpoints, crash-resume below must win or a preempted
     # warm-started run would restart from step 0.
     state = trainer.restore_checkpoint(state, warm_start, params_only=True)
-  train_step = trainer.train_step_fn()
   eval_every = eval_every or params.get('eval_every_n_steps', 3000)
 
   def run_eval(state) -> Dict[str, float]:
@@ -771,6 +974,12 @@ def run_training(
   if resume_from:
     state = trainer.restore_checkpoint(state, resume_from)
     step = int(state.step)
+    # Restore materializes host arrays; re-place under the rule table
+    # so the donated pjit step below sees committed sharded inputs.
+    state = jax.device_put(state, trainer.state_shardings(state))
+  # Compiled against the concrete (placed) state: explicit rule-table
+  # in/out shardings plus donation keep the optimizer update in place.
+  train_step = trainer.train_step_fn(state)
 
   profile_dir = params.get('profile_dir', None)
   if profile_dir:
@@ -851,23 +1060,101 @@ def run_training(
     # tree/shapes); its values are fully overwritten.
     state = trainer.restore_checkpoint(state, latest)
     step = int(state.step)
+    state = jax.device_put(state, trainer.state_shardings(state))
     pending = None
     sentinel.rolled_back(latest)
+
+  # Training degradation ladder (--on_device_error=degrade): the
+  # inference-side dp ladder (runner.degrade_mesh) applied to training.
+  # A permanent DeviceLostError mid-step rebuilds the mesh one dp step
+  # down over the surviving devices, re-places the live state from
+  # memory (checkpoint rollback only when the state itself is
+  # unreadable, i.e. died with the device), recompiles the pjit step,
+  # retargets in-flight prefetched transfers, and re-runs the failed
+  # batch — the run completes instead of crash-looping at fixed dp.
+  on_device_error = params.get('on_device_error', 'fail')
+  n_train_degraded = 0
+  prefetcher: Optional[TrainBatchPrefetcher] = None
+
+  def degrade_mesh() -> bool:
+    nonlocal state, step, pending, train_step, n_train_degraded
+    dp = int(trainer.mesh.shape[mesh_lib.DATA_AXIS])
+    tp = int(trainer.mesh.shape.get(mesh_lib.MODEL_AXIS, 1))
+    new_dp = dp // 2
+    # The global batch must still split evenly over the data axis.
+    while new_dp >= 1 and params.batch_size % new_dp:
+      new_dp //= 2
+    if new_dp < 1 or new_dp >= dp or jax.process_count() > 1:
+      # Single device (nothing smaller) or multi-host (the mesh spans
+      # processes; shrinking it here would desync the others).
+      return False
+    # Pull the live state to host BEFORE abandoning the old mesh: when
+    # the read succeeds the run continues from the exact last step (no
+    # rollback); when the state died with the device, rebuild and fall
+    # back to the last valid checkpoint.
+    contaminated = False
+    host_state = None
+    try:
+      host_state = jax.device_get(state)
+    except Exception:  # pylint: disable=broad-except
+      contaminated = True
+    devices = np.asarray(trainer.mesh.devices).reshape(-1)[:new_dp * tp]
+    trainer.mesh = mesh_lib.make_mesh(dp=new_dp, tp=tp,
+                                      devices=list(devices))
+    trainer._cached_eval_step = None  # eval recompiles on the new mesh
+    if contaminated:
+      latest = trainer.latest_valid_checkpoint()
+      if latest is None:
+        return False
+      state = trainer.init_state(steps_total=decay_steps)
+      state = trainer.restore_checkpoint(state, latest)
+      step = int(state.step)
+      pending = None
+    else:
+      state = host_state
+    state = jax.device_put(state, trainer.state_shardings(state))
+    train_step = trainer.train_step_fn(state)
+    if prefetcher is not None:
+      prefetcher.retarget()
+    n_train_degraded += 1
+    logging.getLogger(__name__).warning(
+        'training mesh degraded to dp=%d after a device loss (step %d '
+        'of the ladder)%s', new_dp, n_train_degraded,
+        '; rolled back to the last valid checkpoint' if contaminated
+        else '; state carried over in memory',
+    )
+    return True
 
   preempted = False
   final_metrics: Dict[str, float] = {}
   try:
-    # Background prefetch: host-side decode/shuffle/stacking for batch
-    # i+1 overlaps the device's step i (the async dispatch returns
-    # before compute finishes). Reference counterpart: tf.data
-    # prefetch(AUTOTUNE) in data_providers.py.
-    for batch in data_lib.prefetch_iterator(maybe_augmented()):
-      names = batch.pop('name', None)
-      faults_lib.maybe_poison_batch(step + 1, batch)
-      host_batch = batch if sentinel.enabled else None
-      batch = trainer.globalize_batch(batch)
-      with jax.profiler.StepTraceAnnotation('train', step_num=step):
-        state, m = train_step(state, batch)
+    # Two prefetch layers: data.prefetch_iterator overlaps host-side
+    # decode/shuffle/stacking with the device step (reference
+    # counterpart: tf.data prefetch(AUTOTUNE) in data_providers.py),
+    # and TrainBatchPrefetcher overlaps the sharded H2D transfer of
+    # batch i+1 with the device's step i.
+    prefetcher = TrainBatchPrefetcher(
+        data_lib.prefetch_iterator(maybe_augmented()),
+        trainer,
+        poison_base_step=step,
+    )
+    for names, host_batch, batch in prefetcher:
+      try:
+        faults_lib.injected_train_device_fault(step + 1)
+        with jax.profiler.StepTraceAnnotation('train', step_num=step):
+          state, m = train_step(state, batch)
+      except Exception as e:  # pylint: disable=broad-except
+        err = faults_lib.classify_device_error(e)
+        if (on_device_error != 'degrade'
+            or not isinstance(err, faults_lib.DeviceLostError)):
+          raise
+        if not degrade_mesh():
+          raise err
+        # The failed batch was consumed from the pipeline but never
+        # applied: re-place it on the rebuilt mesh and re-run.
+        batch = prefetcher.place(host_batch)
+        with jax.profiler.StepTraceAnnotation('train', step_num=step):
+          state, m = train_step(state, batch)
       step += 1
       faults_lib.maybe_kill_train_at_step(step)
       faults_lib.maybe_sigterm_at_step(step)
@@ -940,11 +1227,20 @@ def run_training(
       trainer.log_metrics(step, 'eval', final_metrics)
       trainer.save_checkpoint(state, step, final_metrics)
   finally:
+    if prefetcher is not None:
+      prefetcher.close()
     guard.restore()
     sentinel.close()
     fault_counters: Dict[str, float] = dict(sentinel.counters)
     if stream_ds is not None:
       fault_counters.update(stream_ds.counters)
+    if prefetcher is not None:
+      # Transfer-overlap observability: a clean N-step run reports
+      # train_transfer_overlap_fraction == (N-1)/N (every launch after
+      # the first rides under the previous step's compute).
+      fault_counters.update(prefetcher.stats())
+    if n_train_degraded:
+      fault_counters['n_train_degraded'] = float(n_train_degraded)
     if fault_counters:
       trainer.log_metrics(step, 'faults', fault_counters)
     if profile_dir:

@@ -4,3 +4,9 @@ from deepconsensus_tpu.parallel.mesh import (  # noqa: F401
     param_shardings,
     replicated,
 )
+from deepconsensus_tpu.parallel.partition_rules import (  # noqa: F401
+    DEFAULT_RULES,
+    PartitionRuleError,
+    match_partition_rules,
+    tree_shardings,
+)

@@ -9,13 +9,13 @@ runs use the same code path via jax.distributed initialization.
 """
 from __future__ import annotations
 
-import logging
-import re
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepconsensus_tpu.parallel import partition_rules
 
 DATA_AXIS = 'data'
 MODEL_AXIS = 'model'
@@ -48,53 +48,23 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
   return NamedSharding(mesh, P(DATA_AXIS))
 
 
-# Rules mapping parameter path regexes to PartitionSpecs. Kernel layouts:
-# DenseGeneral qkv [E, N, H] shards heads; output_transform [N, H, E]
-# shards heads; FFN filter [E, F] / [F, E] shards the filter dim.
-_PARAM_RULES: Tuple[Tuple[str, P], ...] = (
-    (r'.*self_attention.*/(query|key|value)/kernel', P(None, MODEL_AXIS, None)),
-    (r'.*self_attention.*/output_transform/kernel', P(MODEL_AXIS, None, None)),
-    (r'.*ffn_\d+/filter_layer/kernel', P(None, MODEL_AXIS)),
-    (r'.*ffn_\d+/filter_layer/bias', P(MODEL_AXIS)),
-    (r'.*ffn_\d+/output_layer/kernel', P(MODEL_AXIS, None)),
-)
-
-
-def _spec_for_path(path: str) -> P:
-  for pattern, spec in _PARAM_RULES:
-    if re.fullmatch(pattern, path):
-      return spec
-  return P()
+# The declarative regex rule table now lives in partition_rules.py and
+# is shared by train, eval, distill, and the inference loaders; this
+# alias keeps the historical import site working.
+_PARAM_RULES = partition_rules.DEFAULT_RULES
 
 
 def param_shardings(mesh: Mesh, params):
   """NamedSharding tree for a parameter pytree.
 
   Attention heads and FFN filter dims shard over the model axis; all
-  other parameters replicate. With tp=1 meshes every spec degenerates
-  to replication, so the same code serves pure-DP runs.
+  other parameters replicate (the trailing catch-all rule). With tp=1
+  meshes every spec degenerates to replication, so the same code
+  serves pure-DP runs. Delegates to the shared declarative rule table
+  (partition_rules.DEFAULT_RULES), which also shards the full training
+  state — params here, plus optimizer moments in train.py.
   """
-  flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-  shardings = []
-  for path, leaf in flat:
-    path_str = '/'.join(
-        getattr(k, 'key', getattr(k, 'name', str(k))) for k in path
-    )
-    spec = _spec_for_path(path_str)
-    # Guard: only shard if dims divide; otherwise replicate (loudly —
-    # a silent fallback would degrade tp>1 to pure DP with no signal).
-    ok = True
-    for dim, axis in zip(leaf.shape, spec):
-      if axis is not None and dim % mesh.shape[MODEL_AXIS] != 0:
-        ok = False
-    if not ok:
-      logging.getLogger(__name__).warning(
-          'param %s (shape %s) not divisible by tp=%d along %s; '
-          'replicating instead', path_str, leaf.shape,
-          mesh.shape[MODEL_AXIS], spec,
-      )
-    shardings.append(NamedSharding(mesh, spec if ok else P()))
-  return jax.tree_util.tree_unflatten(treedef, shardings)
+  return partition_rules.tree_shardings(mesh, params)
 
 
 def count_model_sharded(shardings) -> int:

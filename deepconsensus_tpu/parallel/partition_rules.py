@@ -1,0 +1,174 @@
+"""Declarative regex partition rules for the training state.
+
+One rule table maps parameter-path regexes to PartitionSpecs and is
+shared by train, eval, and distill (and, through mesh.param_shardings,
+by inference loading). Rules are matched with re.search over
+'/'-joined key paths, first match wins, and every non-scalar leaf MUST
+match some rule — an unmatched leaf raises a typed error instead of
+silently replicating, so adding a parameter family to the model forces
+a sharding decision.
+
+Because the optimizer state (optax LAMB's mu/nu moments) mirrors the
+parameter tree, its leaf paths CONTAIN the parameter paths
+('opt_state/.../mu/encoder/.../kernel'), and the same re.search rules
+shard the moments exactly like their parameters — the property pjit
+needs for a donated, fully-sharded update step. Scalars (step counters,
+schedule state) always get P().
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = 'data'
+MODEL_AXIS = 'model'
+
+
+class PartitionRuleError(ValueError):
+  """A leaf path matched no partition rule (or a rule table problem).
+
+  Typed so tests and callers can distinguish a coverage hole in the
+  rule table from generic config errors; the message carries the
+  offending path so the fix is one added rule."""
+
+
+# The declarative rule table. Kernel layouts: DenseGeneral qkv
+# [E, N, H] shards heads; output_transform [N, H, E] shards heads; FFN
+# filter [E, F] / [F, E] shards the filter dim. The trailing catch-all
+# replicates everything else — remove it to surface unmatched leaves.
+DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
+    (r'self_attention[^/]*/(query|key|value)/kernel',
+     P(None, MODEL_AXIS, None)),
+    (r'self_attention[^/]*/output_transform/kernel',
+     P(MODEL_AXIS, None, None)),
+    (r'ffn_\d+/filter_layer/kernel', P(None, MODEL_AXIS)),
+    (r'ffn_\d+/filter_layer/bias', P(MODEL_AXIS)),
+    (r'ffn_\d+/output_layer/kernel', P(MODEL_AXIS, None)),
+    (r'.*', P()),
+)
+
+
+def _path_str(path) -> str:
+  return '/'.join(
+      getattr(k, 'key', getattr(k, 'name', str(k))) for k in path
+  )
+
+
+def _is_scalar_leaf(leaf) -> bool:
+  return np.ndim(leaf) == 0
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree):
+  """PartitionSpec tree for `tree` via first-match re.search rules.
+
+  Scalar leaves get P() without consulting the table (an int step
+  count should never be forced to match a kernel rule). Every
+  non-scalar leaf must match exactly one rule — the FIRST whose regex
+  re.search-matches its '/'-joined path; no match raises
+  PartitionRuleError naming the path.
+  """
+  flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+  specs = []
+  for path, leaf in flat:
+    if _is_scalar_leaf(leaf):
+      specs.append(P())
+      continue
+    name = _path_str(path)
+    for pattern, spec in rules:
+      if re.search(pattern, name):
+        specs.append(spec)
+        break
+    else:
+      raise PartitionRuleError(
+          f'partition rule not found for param: {name!r} (shape '
+          f'{np.shape(leaf)}); extend the rule table or keep the '
+          f"catch-all ('.*', P()) as the last rule")
+  return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def explain_matches(rules: Sequence[Tuple[str, P]], tree):
+  """{leaf path: index of the (single) rule that matched} — the
+  round-trip observability hook tests assert exactly-once matching
+  with. Scalar leaves are reported with rule index -1."""
+  flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+  out = {}
+  for path, leaf in flat:
+    name = _path_str(path)
+    if _is_scalar_leaf(leaf):
+      out[name] = -1
+      continue
+    for i, (pattern, _) in enumerate(rules):
+      if re.search(pattern, name):
+        out[name] = i
+        break
+    else:
+      raise PartitionRuleError(
+          f'partition rule not found for param: {name!r}')
+  return out
+
+
+def _divisible(leaf, spec: P, mesh: Mesh) -> bool:
+  shape = np.shape(leaf)
+  for dim, axis in zip(shape, spec):
+    if axis is None:
+      continue
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    n = 1
+    for a in axes:
+      n *= mesh.shape[a]
+    if n and dim % n != 0:
+      return False
+  return True
+
+
+def tree_shardings(mesh: Mesh, tree,
+                   rules: Optional[Sequence[Tuple[str, P]]] = None):
+  """NamedSharding tree for any state pytree under the rule table.
+
+  Applies match_partition_rules and lowers each spec to a
+  NamedSharding, guarding divisibility: a leaf whose sharded dims do
+  not divide the mesh axis replicates instead — loudly, because a
+  silent fallback would degrade tp>1 to pure DP with no signal.
+  """
+  rules = DEFAULT_RULES if rules is None else rules
+  specs = match_partition_rules(rules, tree)
+  flat_specs, treedef = jax.tree_util.tree_flatten(
+      specs, is_leaf=lambda x: isinstance(x, P))
+  flat_leaves = jax.tree_util.tree_leaves(tree)
+  shardings = []
+  for leaf, spec in zip(flat_leaves, flat_specs):
+    if not _divisible(leaf, spec, mesh):
+      logging.getLogger(__name__).warning(
+          'param (shape %s) not divisible by the mesh along %s; '
+          'replicating instead', np.shape(leaf), spec)
+      spec = P()
+    shardings.append(NamedSharding(mesh, spec))
+  return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def compile_parallel(fn, *, in_shardings=None, out_shardings=None,
+                     donate_argnums=(), static_argnums=()):
+  """Compile an SPMD step: pjit when explicit shardings are provided.
+
+  jax.jit with explicit in/out shardings IS pjit in modern JAX; this
+  helper keeps the choice in one place. shard_map would be the
+  alternative when per-device code (manual collectives) is needed —
+  nothing in the train/eval/distill steps is, so the helper always
+  takes the pjit path and exists so a future manual-collective step
+  changes one function instead of three call sites.
+  """
+  kwargs = {}
+  if in_shardings is not None:
+    kwargs['in_shardings'] = in_shardings
+  if out_shardings is not None:
+    kwargs['out_shardings'] = out_shardings
+  if donate_argnums:
+    kwargs['donate_argnums'] = donate_argnums
+  if static_argnums:
+    kwargs['static_argnums'] = static_argnums
+  return jax.jit(fn, **kwargs)

@@ -433,6 +433,11 @@ def main(argv: Optional[List[str]] = None) -> int:
           '  DCTPU_FAULT_DEVICE_LOST_AT_PACK=N raise a halted-device '
           'error at the Nth pack — degrade rebuilds the mesh one dp '
           'step down and resubmits\n'
+          '  DCTPU_FAULT_DEVICE_LOST_AT_STEP=N raise a halted-device '
+          'error at the Nth TRAINING step (1-based; fires once) — '
+          '`dctpu train --on_device_error=degrade` rebuilds the mesh '
+          'one dp step down, re-places the live state, and re-runs '
+          'the failed batch\n'
           '  DCTPU_FAULT_DEVICE_HANG_AT_PACK=N hang the Nth pack\'s '
           'finalize so the --dispatch_timeout watchdog must fire\n'
           '  DCTPU_FAULT_DEVICE_HANG_S=<secs>  hang duration for '
@@ -503,6 +508,11 @@ def main(argv: Optional[List[str]] = None) -> int:
   p.add_argument('--fault', required=True, choices=('oom', 'lost', 'hang'))
   p.add_argument('--pack', type=int, default=1,
                  help='1-based dispatch ordinal of the targeted pack.')
+  p.add_argument('--step', type=int, default=None,
+                 help='lost only: arm the TRAINING hook instead — the '
+                 'device is lost at this 1-based train step ('
+                 '`dctpu train --on_device_error=degrade` steps the '
+                 'mesh one dp down and keeps training).')
   p.add_argument('--hang_s', type=float, default=30.0,
                  help='hang: seconds the finalize sleeps (pair with '
                  '--dispatch_timeout below it).')
@@ -567,6 +577,9 @@ def main(argv: Optional[List[str]] = None) -> int:
   if args.command == 'device':
     from deepconsensus_tpu import faults as faults_lib
 
+    if args.step is not None and args.fault != 'lost':
+      parser.error('--step arms the training device-lost hook; it '
+                   'only combines with --fault lost')
     env = {
         'oom': {faults_lib.ENV_DEVICE_OOM_AT_PACK: str(args.pack)},
         'lost': {faults_lib.ENV_DEVICE_LOST_AT_PACK: str(args.pack)},
@@ -575,6 +588,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             faults_lib.ENV_DEVICE_HANG_S: str(args.hang_s),
         },
     }[args.fault]
+    if args.step is not None:
+      env = {faults_lib.ENV_DEVICE_LOST_AT_STEP: str(args.step)}
     cmd = [c for c in args.cmd if c != '--']
     if not cmd:
       for key, value in env.items():

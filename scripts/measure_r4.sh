@@ -131,6 +131,20 @@ run_stage train_stages_b256 900 \
   python "$REPO/scripts/bench_train_stages.py" --batches 256 --steps 6 --scan-too
 run_stage train_scaling 1200 \
   python "$REPO/scripts/bench_train_scaling.py" --batches 256 1024 --steps 6
+# Pod-scale training (round-7 tentpole): real-chip dp scaling of the
+# partition-rule pjit train step + prefetch-overlapped batches.
+# Staged to fire on first live tunnel; until then the host-platform
+# plumbing sweep lives in MULTICHIP_r07.json (bench.py
+# train_dp_scaling stage). Read against train_scaling's b1024 line:
+# dp>1 earns its keep if examples/s scales while
+# train_transfer_overlap_fraction stays at (steps-1)/steps and the
+# loss-curve digest matches dp=1 at equal global batch.
+run_stage train_dp2 900 \
+  python "$REPO/scripts/bench_train_scaling.py" --dp 2 --global_batch 1024 \
+  --train_steps 6
+run_stage train_dp4 900 \
+  python "$REPO/scripts/bench_train_scaling.py" --dp 4 --global_batch 1024 \
+  --train_steps 6
 run_stage train_stages_b1024 900 \
   python "$REPO/scripts/bench_train_stages.py" --batches 1024 --steps 6
 # Pallas wavefront unroll A/B under the persistent compile cache

@@ -60,11 +60,15 @@ fi
 if [[ "${1:-}" == "--device" ]]; then
   shift
   # The device fault domain in isolation: fault classification, the
-  # dispatch watchdog, OOM bisection, and dp 8->4 mesh degradation
-  # (multichip drills run on the 8 faked CPU devices conftest.py
-  # forces via --xla_force_host_platform_device_count).
+  # dispatch watchdog, OOM bisection, and dp 8->4 mesh degradation —
+  # inference (test_device_faults) AND training (test_train_parallel:
+  # partition rules, prefetch overlap, the mid-training device-lost
+  # degradation ladder). Multichip drills run on the 8 faked CPU
+  # devices conftest.py forces via
+  # --xla_force_host_platform_device_count.
   exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_device_faults.py \
+    tests/test_train_parallel.py \
     -q --continue-on-collection-errors "$@"
 fi
 

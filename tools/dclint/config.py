@@ -45,6 +45,8 @@ FAULT_TYPES = frozenset({
     'RequestTooLargeError',
     'CrashLoopError',
     'NonFiniteTrainingError',
+    'BucketedTrainingError',
+    'FlywheelGateError',
     'ExportedArtifactMismatchError',
     'DeviceFault',
     'DeviceOomError',
@@ -95,6 +97,7 @@ JIT_SCOPE = (
     'deepconsensus_tpu/inference/engine.py',
     'deepconsensus_tpu/inference/runner.py',
     'deepconsensus_tpu/serve/service.py',
+    'deepconsensus_tpu/models/train.py',
 )
 
 # Per-batch functions: called once (or more) per dispatched pack, so a
@@ -112,6 +115,12 @@ HOT_FUNCTIONS = {
     'deepconsensus_tpu/serve/service.py': frozenset({
         '_model_loop', '_ingest', '_deliver', '_process_retries',
         '_finish',
+    }),
+    # Training-batch prefetcher (TrainBatchPrefetcher): these run once
+    # per training step, so a host sync on the prefetched transfer
+    # before train_step consumes it serializes H2D against compute.
+    'deepconsensus_tpu/models/train.py': frozenset({
+        '_produce', '_launch', '_put', '__next__', 'place',
     }),
 }
 
@@ -147,7 +156,7 @@ HOST_SYNC_CALLS = frozenset({'float', 'int', 'bool', 'asarray', 'array'})
 # transfer result BEFORE this call is an implicit sync that defeats the
 # transfer/compute overlap (jit-hazards double-buffer rule).
 FORWARD_CALLS = frozenset({'_forward', 'phred_epilogue',
-                           'phred_epilogue_pallas'})
+                           'phred_epilogue_pallas', 'train_step'})
 
 # dtype-downcast sub-rule: modules where an unannotated cast to a
 # reduced-precision dtype is flagged.  With bf16 inference live, a
@@ -182,6 +191,12 @@ GUARDED_BY_SCOPE = (
     'deepconsensus_tpu/inference/runner.py',
     'deepconsensus_tpu/fleet/registry.py',
     'deepconsensus_tpu/fleet/router.py',
+    # TrainBatchPrefetcher's producer thread shares counters and the
+    # mesh-generation with the training loop.
+    'deepconsensus_tpu/models/train.py',
+    # The flywheel orchestration dispatch (train/distill drive their
+    # own threads through run_training's machinery).
+    'deepconsensus_tpu/cli.py',
 )
 
 # Attribute initialisers of these types are synchronisation primitives
