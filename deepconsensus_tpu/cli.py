@@ -149,6 +149,15 @@ def _add_run(sub):
   _add_quant_flags(p)
   _add_bucket_flag(p)
   _add_device_fault_flags(p)
+  _add_trace_flag(p)
+
+
+def _add_trace_flag(p):
+  p.add_argument('--trace', default=None, metavar='TRACE.jsonl',
+                 help='Append Chrome-trace-event spans (Perfetto-'
+                 'loadable) to this file. Equivalent to setting '
+                 'DCTPU_TRACE; fleet tiers may share one file. '
+                 'Summarize with `dctpu trace`.')
 
 
 def _add_epilogue_flag(p):
@@ -288,6 +297,7 @@ def _add_serve(sub):
   _add_quant_flags(p)
   _add_bucket_flag(p)
   _add_device_fault_flags(p)
+  _add_trace_flag(p)
 
 
 def _add_route(sub):
@@ -324,6 +334,7 @@ def _add_route(sub):
   p.add_argument('--upstream_timeout_s', type=float, default=300.0,
                  help='End-to-end budget for one forwarded request.')
   p.add_argument('--max_body_mb', type=int, default=64)
+  _add_trace_flag(p)
 
 
 def _add_featurize_worker(sub):
@@ -349,6 +360,7 @@ def _add_featurize_worker(sub):
   p.add_argument('--io_timeout_s', type=float, default=20.0)
   p.add_argument('--max_body_mb', type=int, default=64)
   _add_bucket_flag(p)
+  _add_trace_flag(p)
 
 
 def _add_validate(sub):
@@ -393,6 +405,21 @@ def _add_lint(sub):
                  'finding.')
   p.add_argument('--format', choices=('text', 'json'), default='text',
                  dest='lint_format')
+
+
+def _add_trace(sub):
+  p = sub.add_parser(
+      'trace',
+      help='Summarize a DCTPU_TRACE span file: per-stage breakdown, '
+      'critical-path attribution, straggler packs, span-derived '
+      'transfer overlap.')
+  p.add_argument('trace_file', metavar='TRACE.jsonl',
+                 help='Trace written by --trace / DCTPU_TRACE '
+                 '(one file, possibly shared by a whole fleet).')
+  p.add_argument('--json', action='store_true', dest='trace_json',
+                 help='Emit the summary as JSON instead of text.')
+  p.add_argument('--top', type=int, default=10,
+                 help='Max straggler packs listed (default 10).')
 
 
 def _add_train(sub):
@@ -609,6 +636,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_featurize_worker(sub)
   _add_validate(sub)
   _add_lint(sub)
+  _add_trace(sub)
   _add_train(sub)
   _add_distill(sub)
   _add_flywheel(sub)
@@ -636,6 +664,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 def _dispatch(args) -> int:
+  if getattr(args, 'trace', None):
+    # --trace is sugar for DCTPU_TRACE: the env var is what each tier's
+    # *_main reads (and what spawned fleet processes inherit).
+    import os
+
+    os.environ['DCTPU_TRACE'] = args.trace
+
+  if args.command == 'trace':
+    import json
+
+    from deepconsensus_tpu import faults as faults_lib
+    from deepconsensus_tpu.obs import summarize as summarize_lib
+
+    try:
+      events = summarize_lib.load_trace(args.trace_file)
+      summary = summarize_lib.summarize(events)
+    except faults_lib.CorruptInputError as e:
+      print(f'dctpu: {e}', file=sys.stderr)
+      return 2
+    summary['stragglers'] = summary['stragglers'][:max(args.top, 0)]
+    if args.trace_json:
+      print(json.dumps(summary, indent=2))
+    else:
+      print(summarize_lib.format_summary(summary))
+    return 0
 
   if args.command == 'preprocess':
     from deepconsensus_tpu.preprocess.driver import run_preprocess
@@ -918,6 +971,11 @@ def _dispatch(args) -> int:
       mesh = mesh_lib.make_mesh(
           dp=dp, tp=args.tp, devices=jax.devices()[:dp * args.tp]
       )
+    from deepconsensus_tpu import obs as obs_lib
+
+    # SIGUSR2 -> short on-demand jax.profiler capture next to the
+    # output (the batch counterpart of serve's /debugz/profile).
+    obs_lib.profiler.install_sigusr2(args.output + '.profile')
     counters = runner_lib.run_inference(
         subreads_to_ccs=args.subreads_to_ccs,
         ccs_bam=args.ccs_bam,

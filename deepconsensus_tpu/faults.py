@@ -347,6 +347,17 @@ class DeadLetterWriter:
       # e.g. packed-batch attribution (inference) or the offending
       # batch's window ids / fingerprint (training NaN sentinel).
       entry.update(extra)
+    if 'trace_id' not in entry:
+      # Cross-tier forensics: a failed item's dead letter carries the
+      # request/run trace id when one is bound to this thread (serve
+      # paths pass it explicitly in `extra` instead — the model loop
+      # serves many requests). Lazy import: obs.summarize reads this
+      # module's fault types.
+      from deepconsensus_tpu.obs import trace as _trace_lib
+
+      trace_id = _trace_lib.get_trace_id()
+      if trace_id:
+        entry['trace_id'] = trace_id
     json.dump(
         entry,
         self._f,

@@ -31,11 +31,12 @@ import socket
 import tempfile
 import threading
 import time
-from collections import deque
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.preprocess import (
     FeatureLayout,
@@ -72,25 +73,25 @@ class FeaturizeService:
         options.max_passes, options.max_length, options.use_ccs_bq,
         window_buckets=options.window_buckets or None)
     self._lock = threading.Lock()
-    # guarded by: self._lock
-    self._counters: Dict[str, int] = {
-        'n_requests': 0,
-        'n_featurized': 0,
-        'n_windows': 0,
-        'n_packed_compact': 0,
-        'n_packed_float': 0,
-        'n_bad_requests': 0,
-    }
-    self._latencies: deque = deque(maxlen=2048)  # guarded by: self._lock
+    # Central metrics registry (obs/metrics.py): counters pre-created
+    # so /metricz always exposes the full set; the request-latency
+    # histogram replaces the deque percentile math.
+    self.obs = obs_lib.MetricsRegistry(tier='featurize')
+    for key in ('n_requests', 'n_featurized', 'n_windows',
+                'n_packed_compact', 'n_packed_float', 'n_bad_requests'):
+      self.obs.counter(key)
+    self._latency_hist = self.obs.histogram(
+        'featurize_request_latency_s',
+        help='bam/1 decode + featurize latency per request')
     self._in_flight = 0  # guarded by: self._lock
     self._draining = False  # dclint: lock-free (monotonic bool flip;
     # an admission racing the flip finishes normally before drain())
 
   def bump(self, key: str, n: int = 1) -> None:
-    with self._lock:
-      self._counters[key] = self._counters.get(key, 0) + n
+    self.obs.inc(key, n)
 
-  def featurize(self, body: bytes) -> bytes:
+  def featurize(self, body: bytes,
+                trace_id: Optional[str] = None) -> bytes:
     """One bam/1 request -> one /v1/polish-ready body. Raises typed
     ServeRejection subtypes on anything malformed."""
     if self._draining:
@@ -99,6 +100,7 @@ class FeaturizeService:
     with self._lock:
       self._in_flight += 1
     t0 = time.monotonic()
+    t_wall = time.time()
     try:
       req = protocol.decode_bam_request(body)
       features = self._featurize_bam(req)
@@ -112,8 +114,7 @@ class FeaturizeService:
         self.bump('n_packed_float')
       self.bump('n_featurized')
       self.bump('n_windows', len(features))
-      with self._lock:
-        self._latencies.append(time.monotonic() - t0)
+      self._latency_hist.observe(time.monotonic() - t0)
       return pack
     except shared_faults.ServeRejection:
       self.bump('n_bad_requests')
@@ -121,6 +122,10 @@ class FeaturizeService:
     finally:
       with self._lock:
         self._in_flight -= 1
+      # The worker's leg of the cross-tier trace: the featurize stage
+      # span carries the router-minted trace id.
+      obs_lib.record_stage(self.obs, obs_lib.trace.STAGE_FEATURIZE,
+                           t_wall, time.time(), trace_id=trace_id)
 
   def _featurize_bam(self, req: Dict[str, Any]):
     """Runs the hardened feeder over the request's mini BAMs. The
@@ -189,25 +194,26 @@ class FeaturizeService:
   def ready(self) -> bool:
     return not self._draining
 
+  def prom_text(self) -> str:
+    """/metricz?format=prom payload."""
+    return self.obs.to_prom('featurize')
+
   def stats(self) -> Dict[str, Any]:
+    counters = self.obs.counter_values()
+    registry_view = self.obs.snapshot()
     with self._lock:
-      counters = dict(self._counters)
       in_flight = self._in_flight
-      lat = sorted(self._latencies)
-    latency: Dict[str, Any] = {'p50_s': None, 'p99_s': None, 'n': 0}
-    if lat:
-      latency = {
-          'p50_s': round(lat[len(lat) // 2], 4),
-          'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4),
-          'n': len(lat),
-      }
     return {
+        # Unified cross-tier schema (docs/observability.md); 'faults'
+        # stays as a legacy alias of counters.
         'tier': 'featurize',
         'outstanding': in_flight,
         'draining': self._draining,
         'ready': self.ready,
+        'counters': counters,
+        'histograms': registry_view['histograms'],
         'faults': counters,
-        'latency': latency,
+        'latency': self._latency_hist.percentiles(),
     }
 
 
@@ -252,16 +258,22 @@ def _make_handler(service: FeaturizeService):
           {'error': str(e), 'kind': e.kind, 'status': e.http_status})
 
     def do_GET(self):
-      if self.path == '/healthz':
+      path, _, query = self.path.partition('?')
+      params_qs = urllib.parse.parse_qs(query)
+      if path == '/healthz':
         self._reply_json(200, {'ok': True})
-      elif self.path == '/readyz':
+      elif path == '/readyz':
         if service.ready:
           self._reply_json(200, {'ready': True, 'tier': 'featurize'})
         else:
           self._reply_json(503, {'ready': False, 'tier': 'featurize',
                                  'draining': service._draining})
-      elif self.path == '/metricz':
-        self._reply_json(200, service.stats())
+      elif path == '/metricz':
+        if params_qs.get('format', [''])[0] == 'prom':
+          self._reply(200, service.prom_text().encode(),
+                      content_type='text/plain; version=0.0.4')
+        else:
+          self._reply_json(200, service.stats())
       else:
         self._reply_json(404, {'error': f'no such path: {self.path}'})
 
@@ -289,7 +301,8 @@ def _make_handler(service: FeaturizeService):
         self.close_connection = True
         return
       try:
-        pack = service.featurize(body)
+        pack = service.featurize(
+            body, trace_id=self.headers.get(protocol.TRACE_HEADER) or None)
       except shared_faults.ServeRejection as e:
         self._reply_error(e)
         return
@@ -313,6 +326,7 @@ def worker_main(options: FeaturizeWorkerOptions,
                 ready_fn=None, stop_event=None) -> Dict[str, Any]:
   """Runs the worker until SIGTERM/SIGINT, then drains (same contract
   as serve_main / route_main)."""
+  obs_lib.trace.configure_from_env(tier='featurize')
   service = FeaturizeService(options)
   httpd = build_worker(service, host, port)
   bound_port = httpd.server_address[1]

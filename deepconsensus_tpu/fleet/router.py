@@ -44,11 +44,12 @@ import logging
 import threading
 import time
 import socket
-from collections import deque
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.fleet import registry as registry_lib
 from deepconsensus_tpu.fleet.balancer import LeastLoadedBalancer
 from deepconsensus_tpu.serve import protocol
@@ -101,21 +102,19 @@ class RouterCore:
     self.balancer = LeastLoadedBalancer(
         registry, max_inflight=self.options.max_inflight)
     self._lock = threading.Lock()
-    # guarded by: self._lock
-    self._counters: Dict[str, int] = {
-        'n_requests': 0,
-        'n_routed_model': 0,
-        'n_routed_featurize': 0,
-        'n_retries': 0,
-        'n_rejected_saturated': 0,
-        'n_replica_lost': 0,
-        'n_bad_requests': 0,
-        'n_upstream_rejects_relayed': 0,
-        'n_registered': 0,
-    }
-    # guarded by: self._lock
-    self._latencies: Dict[str, deque] = {
-        tier: deque(maxlen=self.options.latency_window)
+    # Central metrics registry (obs/metrics.py): counters pre-created
+    # so /metricz always exposes the full set, per-tier forwarding
+    # latency histograms replacing the deque percentile math.
+    self.obs = obs_lib.MetricsRegistry(tier='router')
+    for key in ('n_requests', 'n_routed_model', 'n_routed_featurize',
+                'n_retries', 'n_rejected_saturated', 'n_replica_lost',
+                'n_bad_requests', 'n_upstream_rejects_relayed',
+                'n_registered'):
+      self.obs.counter(key)
+    self._tier_hists = {
+        tier: self.obs.histogram(
+            f'route_{tier}_latency_s',
+            help=f'forwarding latency to the {tier} tier')
         for tier in registry_lib.TIERS
     }
     self._draining = False  # dclint: lock-free (monotonic bool flip,
@@ -124,8 +123,7 @@ class RouterCore:
     self._in_flight = 0  # guarded by: self._lock
 
   def bump(self, key: str, n: int = 1) -> None:
-    with self._lock:
-      self._counters[key] = self._counters.get(key, 0) + n
+    self.obs.inc(key, n)
 
   # -- forwarding --------------------------------------------------------
 
@@ -208,8 +206,7 @@ class RouterCore:
         last_reject = _UpstreamRejected(status, data, draining)
         continue
       self.balancer.release(replica.url, 'ok')
-      with self._lock:
-        self._latencies[tier].append(time.monotonic() - t0)
+      self._tier_hists[tier].observe(time.monotonic() - t0)
       return status, data, ctype
     if last_reject is not None:
       self.bump('n_upstream_rejects_relayed')
@@ -224,19 +221,27 @@ class RouterCore:
   # -- request entry -----------------------------------------------------
 
   def route(self, body: bytes,
-            deadline_header: Optional[str] = None
-            ) -> Tuple[int, bytes, str]:
+            deadline_header: Optional[str] = None,
+            trace_id: Optional[str] = None) -> Tuple[int, bytes, str]:
     """Routes one /v1/polish body; returns (status, body, ctype) to
     relay verbatim. Raises ServeRejection subtypes for router-level
-    rejections (mapped to typed JSON by the HTTP layer)."""
+    rejections (mapped to typed JSON by the HTTP layer).
+
+    The router is the fleet's outermost tier, so it mints the trace id
+    (unless the client sent one) and stamps it into the forwarded
+    headers — every downstream span joins this request's trace."""
     if self._draining:
       raise shared_faults.DrainingError('router is draining')
     self.bump('n_requests')
+    trace_id = trace_id or obs_lib.trace.mint_trace_id()
+    t_route = time.time()
+    frame = ''
     with self._lock:
       self._in_flight += 1
     try:
       frame = protocol.sniff_frame(body)
-      headers = {'Content-Type': protocol.CONTENT_TYPE}
+      headers = {'Content-Type': protocol.CONTENT_TYPE,
+                 protocol.TRACE_HEADER: trace_id}
       if deadline_header:
         headers[protocol.DEADLINE_HEADER] = deadline_header
       if frame == protocol.FRAME_BAM:
@@ -255,6 +260,9 @@ class RouterCore:
     finally:
       with self._lock:
         self._in_flight -= 1
+      obs_lib.trace.complete_event(
+          'route', 'request', t_route, time.time(),
+          {'trace_id': trace_id, 'frame': frame})
 
   # -- lifecycle / views -------------------------------------------------
 
@@ -287,23 +295,20 @@ class RouterCore:
     }
 
   def _latency_percentiles(self) -> Dict[str, Dict[str, Any]]:
-    with self._lock:
-      snap = {tier: sorted(d) for tier, d in self._latencies.items()}
-    out = {}
-    for tier, lat in snap.items():
-      if not lat:
-        out[tier] = {'p50_s': None, 'p99_s': None, 'n': 0}
-      else:
-        out[tier] = {
-            'p50_s': round(lat[len(lat) // 2], 4),
-            'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4),
-            'n': len(lat),
-        }
-    return out
+    # Nearest-rank on the per-tier histograms (same fix as the serve
+    # replica's latency_percentiles; old keys alias for one release).
+    return {tier: h.percentiles() for tier, h in self._tier_hists.items()}
+
+  def prom_text(self) -> str:
+    """/metricz?format=prom payload."""
+    return (self.obs.to_prom('router')
+            + obs_lib.metrics.prom_counters_text(
+                self.registry.aggregate_counters(), tier='fleet'))
 
   def stats(self) -> Dict[str, Any]:
+    counters = self.obs.counter_values()
+    registry_view = self.obs.snapshot()
     with self._lock:
-      counters = dict(self._counters)
       in_flight = self._in_flight
     replicas = []
     for r in self.registry.snapshot():
@@ -323,11 +328,17 @@ class RouterCore:
           'n_lost': r.n_lost,
       })
     return {
-        'router': counters,
-        'in_flight': in_flight,
+        # Unified cross-tier schema (docs/observability.md); 'router'
+        # and 'in_flight' stay as legacy aliases of counters/outstanding.
+        'tier': 'router',
+        'outstanding': in_flight,
         'draining': self._draining,
         'ready': self.ready,
+        'counters': counters,
+        'histograms': registry_view['histograms'],
         'latency': self._latency_percentiles(),
+        'router': counters,
+        'in_flight': in_flight,
         'replicas': replicas,
         'fleet_counters': self.registry.aggregate_counters(),
     }
@@ -374,13 +385,19 @@ def _make_handler(core: RouterCore):
           {'error': str(e), 'kind': e.kind, 'status': e.http_status})
 
     def do_GET(self):
-      if self.path == '/healthz':
+      path, _, query = self.path.partition('?')
+      params_qs = urllib.parse.parse_qs(query)
+      if path == '/healthz':
         self._reply_json(200, {'ok': True})
-      elif self.path == '/readyz':
+      elif path == '/readyz':
         info = core.readyz()
         self._reply_json(200 if info['ready'] else 503, info)
-      elif self.path == '/metricz':
-        self._reply_json(200, core.stats())
+      elif path == '/metricz':
+        if params_qs.get('format', [''])[0] == 'prom':
+          self._reply(200, core.prom_text().encode(),
+                      content_type='text/plain; version=0.0.4')
+        else:
+          self._reply_json(200, core.stats())
       else:
         self._reply_json(404, {'error': f'no such path: {self.path}'})
 
@@ -414,7 +431,8 @@ def _make_handler(core: RouterCore):
         try:
           status, data, ctype = core.route(
               body,
-              deadline_header=self.headers.get(protocol.DEADLINE_HEADER))
+              deadline_header=self.headers.get(protocol.DEADLINE_HEADER),
+              trace_id=self.headers.get(protocol.TRACE_HEADER) or None)
         except shared_faults.ServeRejection as e:
           self._reply_error(e)
           return
@@ -463,6 +481,7 @@ def route_main(replicas: List[str], featurize_workers: List[str],
   drain). Mirrors serve_main's contract: ready_fn(info) fires once
   listening; stop_event is the in-process SIGTERM stand-in."""
   options = options or RouterOptions()
+  obs_lib.trace.configure_from_env(tier='router')
   registry = registry_lib.ReplicaRegistry(
       probe_interval_s=options.probe_interval_s,
       probe_timeout_s=options.probe_timeout_s)

@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.calibration import lib as calibration_lib
 from deepconsensus_tpu.preprocess.pileup import row_indices
 from deepconsensus_tpu.utils import phred
@@ -153,6 +154,11 @@ class _WindowPacker:
         pack_clock if pack_clock is not None else [0])
     # Clock reading when the current buffered tail started waiting.
     self._starve_mark = 0
+    # Wall stamp of the same event, for the pack_wait span: how long
+    # rows sat buffered before their pack was cut.
+    self._t_buf_start = 0.0
+    # The runner's metrics registry, when it has one (test stubs don't).
+    self._obs = getattr(runner, 'obs', None)
     self.n_packs = 0
     self.n_pack_rows = 0
     self.n_pad_rows = 0
@@ -166,6 +172,7 @@ class _WindowPacker:
     aligned with tickets) and dispatches every full pack now cuttable."""
     if not self._buffered:
       self._starve_mark = self._pack_clock[0]
+      self._t_buf_start = time.time()
     self._rows.append(rows)
     self._tickets.extend(tickets)
     self._buffered += len(rows)
@@ -203,6 +210,13 @@ class _WindowPacker:
     self.n_packs += 1
     self._pack_clock[0] += 1
     self._starve_mark = self._pack_clock[0]
+    t_cut = time.time()
+    obs_lib.record_stage(
+        self._obs, obs_lib.trace.STAGE_PACK_WAIT,
+        self._t_buf_start or t_cut, t_cut,
+        bucket=int(pack.shape[2]), n_rows=len(pack))
+    # Any leftover tail starts a fresh wait from this cut.
+    self._t_buf_start = t_cut
     self.n_pack_rows += len(pack)
     self.n_pad_rows += self._batch - len(pack)
     try:

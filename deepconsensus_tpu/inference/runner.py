@@ -39,6 +39,7 @@ import numpy as np
 warnings.filterwarnings(
     'ignore', message='Some donated buffers were not usable')
 
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.calibration import lib as calibration_lib
 from deepconsensus_tpu.inference import engine as engine_lib
 from deepconsensus_tpu.inference import faults
@@ -352,7 +353,8 @@ class _DispatchHandle:
   dispatch happened to trigger the launch.
   """
 
-  __slots__ = ('inputs', 'n', 'outputs', 'error', 'seq', 'hang_s')
+  __slots__ = ('inputs', 'n', 'outputs', 'error', 'seq', 'hang_s',
+               't_launch', 'bucket')
 
   def __init__(self, inputs, n: int):
     self.inputs = inputs  # (main_u8_dev, sn_dev); cleared at launch
@@ -361,6 +363,8 @@ class _DispatchHandle:
     self.error = None
     self.seq = 0  # 1-based dispatch ordinal (fault-injection target)
     self.hang_s = 0.0  # injected finalize hang (watchdog drills)
+    self.t_launch = 0.0  # forward-launch wall stamp (device_compute span)
+    self.bucket = 0  # window width (straggler context in traces)
 
   @property
   def launched(self) -> bool:
@@ -536,6 +540,10 @@ class ModelRunner:
       self._input_sharding = mesh_lib.batch_sharding(mesh)
     else:
       self._input_sharding = None
+    # One metrics registry per runner process; the engine, service and
+    # batch driver all observe into this same registry so /metricz and
+    # the run sidecar read one coherent view (obs/metrics.py).
+    self.obs = obs_lib.MetricsRegistry()
     # dclint: lock-free (single transfer slot: the model-loop thread
     # is the sole device owner — dispatch/finalize are never called
     # concurrently, per the engine's single-thread contract)
@@ -765,9 +773,11 @@ class ModelRunner:
       main_u8[:, self._bq_row] = (main[:, self._bq_row] + 1.0).astype(
           np.uint8)
     sn = np.ascontiguousarray(rows[:, -_SN_ROWS:, 0, 0].astype(np.float32))
+    width = int(rows.shape[2])
     # Launch the previous pack's forward BEFORE starting this pack's
     # transfer, so the device_put below overlaps its compute.
     self._launch_pending()
+    t_h2d = time.time()
     if self._input_sharding is not None:
       main_dev = jax.device_put(main_u8, self._input_sharding)
       sn_dev = jax.device_put(sn, self._input_sharding)
@@ -776,16 +786,19 @@ class ModelRunner:
       main_dev = jax.device_put(main_u8)
       sn_dev = jax.device_put(sn)
     self._n_dispatched += 1
+    obs_lib.record_stage(self.obs, obs_lib.trace.STAGE_H2D,
+                         t_h2d, time.time(), pack=self._n_dispatched,
+                         bucket=width, dp=self.mesh_dp, n_rows=n)
     if self._device_epilogue:
       self._n_epilogue_packs += 1
     # Per-bucket compile-once accounting: jit keeps one executable per
     # distinct (batch, L); the set is the compile count.
-    width = int(rows.shape[2])
     self._forward_shapes.add((batch, width))
     self._n_dispatched_by_bucket[width] = (
         self._n_dispatched_by_bucket.get(width, 0) + 1)
     handle = _DispatchHandle((main_dev, sn_dev), n)
     handle.seq = self._n_dispatched
+    handle.bucket = width
     self._pending = handle
     return handle
 
@@ -807,6 +820,10 @@ class ModelRunner:
     # Drop our references before the call: the jit donates these
     # buffers, so they must not be reachable (or reused) afterwards.
     handle.inputs = None
+    # Launch stamp: the device_compute span runs launch -> drain, and
+    # launch-before-finalize ordering is the span-derived overlap
+    # signal dctpu trace reconciles against the counters.
+    handle.t_launch = time.time()
     try:
       faults.injected_device_fault(handle.seq)
       handle.hang_s = faults.injected_device_hang(handle.seq)
@@ -925,6 +942,27 @@ class ModelRunner:
     return self._finalize_sync(dispatched)
 
   def _finalize_sync(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
+    """Timing shell around the blocking drain: emits the pack's
+    finalize_drain span, and a device_compute span running from the
+    forward-launch stamp to drain completion. The two spans' start
+    ordering is the span-derived overlap fraction: an overlapped pack
+    was launched by a later dispatch (launch stamp BEFORE finalize
+    began); a direct launch happens inside finalize."""
+    t_fin = time.time()
+    try:
+      return self._drain_sync(dispatched)
+    finally:
+      t_end = time.time()
+      handle = dispatched
+      obs_lib.record_stage(self.obs, obs_lib.trace.STAGE_FINALIZE,
+                           t_fin, t_end, pack=handle.seq)
+      if handle.t_launch:
+        obs_lib.record_stage(
+            self.obs, obs_lib.trace.STAGE_DEVICE_COMPUTE,
+            handle.t_launch, t_end, pack=handle.seq,
+            bucket=handle.bucket, dp=self.mesh_dp, n_rows=handle.n)
+
+  def _drain_sync(self, dispatched) -> Tuple[np.ndarray, np.ndarray]:
     """The blocking half of finalize: device sync, plus host quality
     math only on the fallback path (with the device epilogue on, the
     quality integers already left the device final — this is a pure
@@ -1319,6 +1357,14 @@ def run_inference(
       options.window_buckets or getattr(params, 'window_buckets', None),
       params.max_length)
 
+  # Run-scoped tracing: honor DCTPU_TRACE unless the CLI already
+  # configured a writer, and stamp every span (and dead letter) from
+  # this run's threads with one minted trace id.
+  if not obs_lib.trace.enabled():
+    obs_lib.trace.configure_from_env(tier='run')
+  run_trace_id = obs_lib.trace.mint_trace_id()
+  obs_lib.trace.set_trace_id(run_trace_id)
+
   fail_fast = options.on_zmw_error == faults.OnZmwError.FAIL
   dead_letter: Optional[faults.DeadLetterWriter] = None
   quarantine: Optional[faults.Quarantine] = None
@@ -1582,12 +1628,16 @@ def run_inference(
           n_subreads += len(zmw_input[0]) - 1
           zmw_counters.append(zmw_counter)
           all_windows.extend(features)
+        t_end = time.time()
+        obs_lib.record_stage(runner.obs, obs_lib.trace.STAGE_FEATURIZE,
+                             t0, t_end, n_zmws=len(zmw_batch),
+                             n_windows=len(all_windows))
         return {
             'windows': all_windows,
             'counters': zmw_counters,
             'n_subreads': n_subreads,
             'n_zmws': len(zmw_batch),
-            'preprocess_time': time.time() - t0,
+            'preprocess_time': t_end - t0,
             'shm_handles': shm_handles,
             'fallbacks': fallbacks,
         }
@@ -1645,6 +1695,7 @@ def run_inference(
         return False
 
       def producer():
+        obs_lib.trace.set_trace_id(run_trace_id)  # thread-local
         try:
           def flush(zmw_batch) -> bool:
             if not zmw_batch:
@@ -1824,9 +1875,13 @@ def run_inference(
             quarantine.handle(name, 'stitch', e, fallback=None)
         for fb in feat.get('fallbacks', ()):
           emit_fallback(fb)
+        t_end = time.time()
+        obs_lib.record_stage(runner.obs, obs_lib.trace.STAGE_STITCH,
+                             t0, t_end, n_zmws=feat['n_zmws'],
+                             n_windows=state.n_windows)
         timing_rows.append(
             dict(stage='stitch_and_write_fastq',
-                 runtime=time.time() - t0, n_zmws=feat['n_zmws'],
+                 runtime=t_end - t0, n_zmws=feat['n_zmws'],
                  n_examples=state.n_windows,
                  n_subreads=feat['n_subreads']))
         if 'groups_end' in feat:
@@ -1842,6 +1897,7 @@ def run_inference(
           )
 
       def emit_worker() -> None:
+        obs_lib.trace.set_trace_id(run_trace_id)  # thread-local
         emitted = 0
         try:
           while not emit_stop.is_set():

@@ -31,6 +31,7 @@ import orbax.checkpoint as ocp
 
 from deepconsensus_tpu import constants
 from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.models import checkpoints as checkpoints_lib
 from deepconsensus_tpu.models import config as config_lib
 from deepconsensus_tpu.models import data as data_lib
@@ -186,6 +187,13 @@ class Trainer:
     self._metrics_tsv = os.path.join(self.out_dir, 'checkpoint_metrics.tsv')
     self._best_file = os.path.join(self.out_dir, 'best_checkpoint.txt')
     self._metrics_jsonl = os.path.join(self.out_dir, 'metrics.jsonl')
+    # Central metrics registry (obs/): the metrics sidecar mirrors every
+    # logged scalar into typed gauges and the training loop feeds the
+    # step-time histogram, so `obs.metrics` sees train the same way it
+    # sees serve/router/featurize tiers.
+    self.obs = obs_lib.MetricsRegistry(tier='train')
+    self.step_time_hist = self.obs.histogram(
+        'train_step_s', help='wall time per training step')
     # Which eval metric selects best_checkpoint.txt. The reference pins
     # per_example_accuracy (whole-window exact match); on small or
     # held-out eval sets that metric can tie at 0.0 for every
@@ -522,6 +530,11 @@ class Trainer:
   def log_metrics(self, step: int, split: str, metrics: Dict[str, float]):
     if jax.process_index() != 0:
       return
+    for name, value in metrics.items():
+      try:
+        self.obs.set_gauge(f'{split}/{name}', float(value))
+      except (TypeError, ValueError):
+        continue
     entry = {'step': step, 'split': split, 'time': time.time(), **metrics}
     with open(self._metrics_jsonl, 'a') as f:
       f.write(json.dumps(entry) + '\n')
@@ -981,6 +994,14 @@ def run_training(
   # in/out shardings plus donation keep the optimizer update in place.
   train_step = trainer.train_step_fn(state)
 
+  # Fleet tracing + on-demand profiler: spans and dead letters from
+  # this run carry one minted trace id; SIGUSR2 triggers a short
+  # jax.profiler capture into <out_dir>/profile — the batch-side
+  # counterpart of serve's /debugz/profile endpoint.
+  obs_lib.trace.configure_from_env(tier='train')
+  obs_lib.trace.set_trace_id(obs_lib.trace.mint_trace_id())
+  obs_lib.profiler.install_sigusr2(os.path.join(out_dir, 'profile'))
+
   profile_dir = params.get('profile_dir', None)
   if profile_dir:
     jax.profiler.start_trace(profile_dir)
@@ -1138,6 +1159,7 @@ def run_training(
         trainer,
         poison_base_step=step,
     )
+    t_step = time.time()
     for names, host_batch, batch in prefetcher:
       try:
         faults_lib.injected_train_device_fault(step + 1)
@@ -1156,6 +1178,14 @@ def run_training(
         with jax.profiler.StepTraceAnnotation('train', step_num=step):
           state, m = train_step(state, batch)
       step += 1
+      # Per-iteration wall time (dispatch-to-dispatch, which converges
+      # to device step time once the pipeline fills) feeds the registry
+      # histogram and — when DCTPU_TRACE is set — a train_step span.
+      t_now = time.time()
+      trainer.step_time_hist.observe(t_now - t_step)
+      obs_lib.trace.complete_event('train_step', 'train', t_step, t_now,
+                                   {'step': step})
+      t_step = t_now
       faults_lib.maybe_kill_train_at_step(step)
       faults_lib.maybe_sigterm_at_step(step)
       if sentinel.enabled:
@@ -1241,6 +1271,10 @@ def run_training(
       fault_counters.update(prefetcher.stats())
     if n_train_degraded:
       fault_counters['n_train_degraded'] = float(n_train_degraded)
+    step_times = trainer.step_time_hist.percentiles()
+    if step_times['count']:
+      fault_counters['train_step_p50_s'] = step_times['p50']
+      fault_counters['train_step_p99_s'] = step_times['p99']
     if fault_counters:
       trainer.log_metrics(step, 'faults', fault_counters)
     if profile_dir:

@@ -1,0 +1,44 @@
+"""Unified observability plane: metrics registry, trace spans,
+profiler capture, trace summarization.
+
+``record_stage`` is the one helper every pipeline instrumentation site
+calls: it feeds the SAME measured interval to both the stage histogram
+(``stage_<name>_s`` on the tier's MetricsRegistry) and the trace span,
+which is what makes span-derived per-stage totals reconcile with
+/metricz histogram sums by construction.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from deepconsensus_tpu.obs import metrics
+from deepconsensus_tpu.obs import profiler
+from deepconsensus_tpu.obs import summarize
+from deepconsensus_tpu.obs import trace
+from deepconsensus_tpu.obs.metrics import (DEFAULT_LATENCY_BUCKETS,
+                                           MetricsRegistry)
+
+
+def stage_histogram_name(stage: str) -> str:
+  return f'stage_{stage}_s'
+
+
+def record_stage(registry: Optional[MetricsRegistry], stage: str,
+                 t0: float, t1: float, **args: Any) -> None:
+  """Records one pipeline-stage interval [t0, t1] (time.time() stamps)
+  as both a histogram observation and a trace span."""
+  if registry is not None:
+    registry.observe(stage_histogram_name(stage), t1 - t0)
+  trace.complete_event(stage, 'stage', t0, t1, args)
+
+
+__all__ = [
+    'DEFAULT_LATENCY_BUCKETS',
+    'MetricsRegistry',
+    'metrics',
+    'profiler',
+    'record_stage',
+    'stage_histogram_name',
+    'summarize',
+    'trace',
+]

@@ -1,0 +1,90 @@
+"""On-demand jax.profiler capture.
+
+Two entry points over one guarded capture primitive:
+
+* ``/debugz/profile?seconds=N`` on a serve replica (serve/server.py)
+  — an operator points Perfetto at a live replica without restarting
+  it;
+* SIGUSR2 on batch ``dctpu run`` / ``dctpu train`` — ``kill -USR2``
+  a long batch job and collect the device trace it was too late to
+  have asked for at launch.
+
+jax is imported lazily inside the capture so this module stays
+importable on the jax-free featurize tier, and a concurrent second
+capture is refused (jax.profiler supports one active trace per
+process) rather than crashing the first.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+_MAX_CAPTURE_S = 120.0
+
+# One capture at a time per process (jax.profiler is a singleton).
+_capture_lock = threading.Lock()
+
+
+def capture_profile(out_dir: str, seconds: float) -> Dict[str, Any]:
+  """Runs one bounded jax.profiler trace into `out_dir`.
+
+  Returns a status dict (never raises on an unavailable profiler: the
+  debug endpoint reports the problem instead of 500ing a live
+  replica). Blocks for `seconds`, so callers own threading.
+  """
+  seconds = min(max(0.1, float(seconds)), _MAX_CAPTURE_S)
+  if not _capture_lock.acquire(blocking=False):
+    return {'ok': False, 'error': 'a profiler capture is already running'}
+  try:
+    try:
+      import jax
+    except Exception as e:  # dclint: allow=typed-faults (availability
+      # probe on a debug endpoint: the error is data, not control flow)
+      return {'ok': False, 'error': f'jax unavailable: {e}'}
+    os.makedirs(out_dir, exist_ok=True)
+    t0 = time.time()
+    try:
+      jax.profiler.start_trace(out_dir)
+      time.sleep(seconds)
+      jax.profiler.stop_trace()
+    except Exception as e:  # dclint: allow=typed-faults (profiler
+      # backends fail in environment-specific ways; the debug endpoint
+      # reports them as payload instead of crashing the replica)
+      return {'ok': False, 'error': f'{type(e).__name__}: {e}'}
+    return {
+        'ok': True,
+        'out_dir': out_dir,
+        'seconds': round(time.time() - t0, 3),
+    }
+  finally:
+    _capture_lock.release()
+
+
+def install_sigusr2(out_dir: str, seconds: float = 5.0) -> bool:
+  """SIGUSR2 -> background jax.profiler capture into `out_dir`.
+
+  Returns False (and stays uninstalled) off the main thread — signal
+  handlers can only be set there, and in-process test harnesses drive
+  run/train from worker threads.
+  """
+
+  def _handler(signum, frame):
+    del signum, frame
+    thread = threading.Thread(
+        target=lambda: log.warning(
+            'SIGUSR2 profile capture: %s',
+            capture_profile(out_dir, seconds)),
+        name='dctpu-profile-capture', daemon=True)
+    thread.start()
+
+  try:
+    signal.signal(signal.SIGUSR2, _handler)
+  except ValueError:  # not the main thread
+    return False
+  return True

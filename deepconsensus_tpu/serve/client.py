@@ -91,7 +91,8 @@ class ServeClient:
              window_pos: np.ndarray, ccs_bq: np.ndarray,
              overflow: np.ndarray,
              meta: Optional[Dict[str, Any]] = None,
-             deadline_s: Optional[float] = None) -> Dict[str, Any]:
+             deadline_s: Optional[float] = None,
+             trace_id: Optional[str] = None) -> Dict[str, Any]:
     """Polishes one molecule. Returns the decoded response dict
     (status/seq/quals/counters/error); raises ServeClientError on a
     typed rejection. Honors the DCTPU_FAULT_SERVE_CLIENT sabotage
@@ -105,6 +106,8 @@ class ServeClient:
     headers = {'Content-Type': protocol.CONTENT_TYPE}
     if deadline_s is not None:
       headers[protocol.DEADLINE_HEADER] = str(deadline_s)
+    if trace_id:
+      headers[protocol.TRACE_HEADER] = trace_id
     status, resp_body, ctype = self._request(
         'POST', '/v1/polish', body=body, headers=headers)
     if status != 200:
@@ -117,7 +120,8 @@ class ServeClient:
     return protocol.decode_response(resp_body)
 
   def polish_features(self, features, deadline_s: Optional[float] = None,
-                      compact: bool = False) -> Dict[str, Any]:
+                      compact: bool = False,
+                      trace_id: Optional[str] = None) -> Dict[str, Any]:
     """polish() from preprocess window feature dicts. compact=True
     ships a features/1 uint8 pack (~4x fewer wire bytes) when the
     tensor packs losslessly, silently falling back to the legacy
@@ -131,10 +135,12 @@ class ServeClient:
     fd0 = features[0]
     name = (fd0['name'] if isinstance(fd0['name'], str)
             else fd0['name'].decode())
-    return self.polish_body(body, name=name, deadline_s=deadline_s)
+    return self.polish_body(body, name=name, deadline_s=deadline_s,
+                            trace_id=trace_id)
 
   def polish_body(self, body: bytes, name: str = '',
-                  deadline_s: Optional[float] = None) -> Dict[str, Any]:
+                  deadline_s: Optional[float] = None,
+                  trace_id: Optional[str] = None) -> Dict[str, Any]:
     """POSTs an already-encoded /v1/polish body (legacy, features/1,
     or — against a router — bam/1). The featurize tier and the soak
     harness reuse this to ship packs without re-encoding."""
@@ -145,6 +151,8 @@ class ServeClient:
     headers = {'Content-Type': protocol.CONTENT_TYPE}
     if deadline_s is not None:
       headers[protocol.DEADLINE_HEADER] = str(deadline_s)
+    if trace_id:
+      headers[protocol.TRACE_HEADER] = trace_id
     status, resp_body, _ = self._request(
         'POST', '/v1/polish', body=body, headers=headers)
     if status != 200:
@@ -157,12 +165,14 @@ class ServeClient:
 
   def polish_bam(self, subreads_bam: bytes, ccs_bam: bytes,
                  name: str = '',
-                 deadline_s: Optional[float] = None) -> Dict[str, Any]:
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None) -> Dict[str, Any]:
     """polish() from one molecule's raw mini-BAM bytes, for use
     against a `dctpu route` front tier with a featurize tier behind
     it (a bare model replica answers a typed 400)."""
     body = protocol.encode_bam_request(subreads_bam, ccs_bam, name=name)
-    return self.polish_body(body, name=name, deadline_s=deadline_s)
+    return self.polish_body(body, name=name, deadline_s=deadline_s,
+                            trace_id=trace_id)
 
 
 # ----------------------------------------------------------------------

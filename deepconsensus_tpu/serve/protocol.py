@@ -65,6 +65,10 @@ from deepconsensus_tpu import faults as faults_lib
 
 CONTENT_TYPE = 'application/octet-stream'
 DEADLINE_HEADER = 'X-Dctpu-Deadline-S'
+# Request/trace id minted at the outermost tier (router for fleet
+# traffic) and carried across every hop so spans from router,
+# featurize worker and replica join into one trace (obs.trace).
+TRACE_HEADER = 'X-Dctpu-Trace-Id'
 REQUEST_FIELDS = ('name', 'subreads', 'window_pos', 'ccs_bq', 'overflow')
 _META_KEYS = ('ec', 'np_num_passes', 'rq', 'rg')
 

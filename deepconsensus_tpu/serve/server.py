@@ -27,14 +27,18 @@ from __future__ import annotations
 import io
 import json
 import logging
+import os
 import signal
 import socket
+import tempfile
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.serve import protocol
 
 # ConsensusService/ServeOptions are imported inside serve_main: the
@@ -128,12 +132,14 @@ def _make_handler(service: ConsensusService):
           {'error': str(e), 'kind': e.kind, 'status': e.http_status})
 
     def do_GET(self):
-      if self.path == '/healthz':
+      path, _, query = self.path.partition('?')
+      params_qs = urllib.parse.parse_qs(query)
+      if path == '/healthz':
         if service.healthy:
           self._reply_json(200, {'ok': True})
         else:
           self._reply_json(503, {'ok': False, 'error': 'model loop died'})
-      elif self.path == '/readyz':
+      elif path == '/readyz':
         # Degraded capacity (mesh stepped down a dp level) stays ready
         # — the service still answers, just slower — but the body says
         # so, so orchestrators can rebalance replicas.
@@ -144,8 +150,26 @@ def _make_handler(service: ConsensusService):
           self._reply_json(
               503, dict({'ready': False, 'draining': service._draining},
                         **capacity))
-      elif self.path == '/metricz':
-        self._reply_json(200, service.stats())
+      elif path == '/metricz':
+        if params_qs.get('format', [''])[0] == 'prom':
+          self._reply(200, service.prom_text().encode(),
+                      content_type='text/plain; version=0.0.4')
+        else:
+          self._reply_json(200, service.stats())
+      elif path == '/debugz/profile':
+        # On-demand jax.profiler capture: blocks this handler thread
+        # for the capture window, never the model loop.
+        try:
+          seconds = float(params_qs.get('seconds', ['5'])[0])
+        except ValueError as e:
+          self._reply_error(
+              shared_faults.BadRequestError(f'bad seconds param: {e}'))
+          return
+        out_dir = (params_qs.get('out', [''])[0]
+                   or os.path.join(tempfile.gettempdir(),
+                                   f'dctpu-profile-{os.getpid()}'))
+        result = obs_lib.profiler.capture_profile(out_dir, seconds)
+        self._reply_json(200 if result['ok'] else 503, result)
       else:
         self._reply_json(404, {'error': f'no such path: {self.path}'})
 
@@ -178,6 +202,7 @@ def _make_handler(service: ConsensusService):
         header = self.headers.get(protocol.DEADLINE_HEADER)
         if header:
           deadline_s = float(header)
+        trace_id = self.headers.get(protocol.TRACE_HEADER) or None
         req = protocol.decode_request(
             body,
             total_rows=params.total_rows,
@@ -185,7 +210,8 @@ def _make_handler(service: ConsensusService):
             max_windows=opts.max_windows_per_request,
             window_buckets=service.engine.window_buckets)
         state = service.submit(req, deadline_s,
-                               client=self.address_string())
+                               client=self.address_string(),
+                               trace_id=trace_id)
         result = service.wait(state)
       except ValueError as e:
         self._reply_error(
@@ -262,6 +288,9 @@ def serve_main(runner, options, serve_options: ServeOptions,
   """
   from deepconsensus_tpu.serve.service import ConsensusService
 
+  # Fleet tracing: every tier appends to the shared trace file named
+  # by DCTPU_TRACE (no-op when unset).
+  obs_lib.trace.configure_from_env(tier='serve')
   service = ConsensusService(runner, options, serve_options)
   warm_s = service.warmup()
   service.start()

@@ -42,6 +42,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from deepconsensus_tpu import faults as shared_faults
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.inference import engine as engine_lib
 from deepconsensus_tpu.inference import faults
 from deepconsensus_tpu.models import data as data_lib
@@ -102,18 +103,24 @@ class _RequestState:
 
   __slots__ = (
       'request_id', 'name', 'client', 'req', 'deadline', 't_submit',
+      't_submit_wall', 'trace_id',
       'pos', 'ids', 'quals', 'tickets', 'model_rows', 'pending',
       'ingested', 'retried', 'adopted', 'cancelled', 'finished',
       'counters', 'result', 'error', 'event')
 
   def __init__(self, request_id: int, req: Dict[str, Any],
-               client: str, deadline: float):
+               client: str, deadline: float,
+               trace_id: Optional[str] = None):
     self.request_id = request_id
     self.name = req['name']
     self.client = client
     self.req = req
     self.deadline = deadline
     self.t_submit = time.monotonic()
+    # Wall-clock twin of t_submit: trace spans live on the shared
+    # wall-clock timeline (obs/trace.py), monotonic stays for deadlines.
+    self.t_submit_wall = time.time()
+    self.trace_id = trace_id or obs_lib.trace.mint_trace_id()
     self.pos: List[int] = []
     self.ids: List[Optional[np.ndarray]] = []
     self.quals: List[Optional[np.ndarray]] = []
@@ -159,8 +166,15 @@ class ConsensusService:
     self._loop_error: Optional[BaseException] = None
     self._next_id = 0  # guarded by: self._lock
     self._retries: List[Tuple[_RequestState, List[_Ticket], int, str]] = []
-    self._latencies: 'collections.deque[float]' = collections.deque(
-        maxlen=8192)  # guarded by: self._lock
+    # One metrics registry per replica: shared with the runner (whose
+    # stage histograms land in the same /metricz view) when it has one;
+    # stub runners in tests get a service-local registry.
+    self.metrics: obs_lib.MetricsRegistry = (
+        getattr(runner, 'obs', None) or obs_lib.MetricsRegistry())
+    self.metrics.tier = self.metrics.tier or 'serve'
+    self._latency_hist = self.metrics.histogram(
+        'serve_request_latency_s',
+        help='end-to-end request latency (submit to result)')
     # dclint: lock-free (mutated only by the model loop via stitch;
     # stats() reads int fields whose torn values are tolerable)
     self.outcome = stitch.OutcomeCounter()
@@ -222,7 +236,8 @@ class ConsensusService:
   # Handler-thread side
 
   def submit(self, req: Dict[str, Any], deadline_s: Optional[float],
-             client: str = '') -> _RequestState:
+             client: str = '',
+             trace_id: Optional[str] = None) -> _RequestState:
     """Admits one decoded request or raises a typed ServeRejection."""
     self.quarantine.bump('n_requests')
     if self._draining or self._stopped.is_set():
@@ -241,7 +256,8 @@ class ConsensusService:
             f'(max_pending={opts.max_pending})')
       self._next_id += 1
       state = _RequestState(self._next_id, req, client,
-                            time.monotonic() + deadline_s)
+                            time.monotonic() + deadline_s,
+                            trace_id=trace_id)
       self._outstanding.add(state)
     try:
       self._queue.put_nowait(state)
@@ -471,6 +487,7 @@ class ConsensusService:
         extra={
             'request_id': state.request_id,
             'client': state.client,
+            'trace_id': state.trace_id,
             'model_pack': pack_seq,
             'n_windows_in_pack': len(ts),
         })
@@ -492,6 +509,7 @@ class ConsensusService:
       return
     if state.result is None:  # not quarantined-skip
       status = 'fallback' if state.adopted else 'ok'
+      t_stitch = time.time()
       try:
         stitched = stitch.stitch_arrays(
             state.name,
@@ -507,10 +525,14 @@ class ConsensusService:
         self.quarantine.handle(
             state.name, 'stitch', e, fallback=None,
             extra={'request_id': state.request_id,
-                   'client': state.client})
+                   'client': state.client,
+                   'trace_id': state.trace_id})
         stitched = None
         status = 'quarantined'
         state.error = f'{type(e).__name__}: {e}'
+      obs_lib.record_stage(self.metrics, obs_lib.trace.STAGE_STITCH,
+                           t_stitch, time.time(),
+                           trace_id=state.trace_id, zmw=state.name)
       if stitched is None and status != 'quarantined':
         status = 'filtered'
       state.result = {
@@ -520,8 +542,15 @@ class ConsensusService:
           'counters': dict(state.counters),
           'error': state.error or '',
       }
-    with self._lock:
-      self._latencies.append(time.monotonic() - state.t_submit)
+    t_done = time.time()
+    self._latency_hist.observe(time.monotonic() - state.t_submit)
+    # Request-level span: the replica's leg of the cross-tier trace
+    # (joined to router/featurize-worker spans by trace_id).
+    obs_lib.trace.complete_event(
+        'serve_request', 'request', state.t_submit_wall, t_done,
+        {'trace_id': state.trace_id, 'zmw': state.name,
+         'request_id': state.request_id,
+         'status': (state.result or {}).get('status', 'cancelled')})
     state.event.set()
 
   def _release(self, state: _RequestState) -> None:
@@ -554,18 +583,20 @@ class ConsensusService:
     }
 
   def latency_percentiles(self) -> Dict[str, Optional[float]]:
-    # Snapshot under the lock: sorted() iterates the deque, and a
-    # concurrent model-loop append raises "deque mutated during
-    # iteration" under /metricz traffic.
-    with self._lock:
-      lat = sorted(self._latencies)
-    if not lat:
-      return {'p50_s': None, 'p99_s': None, 'n': 0}
-    return {
-        'p50_s': round(lat[len(lat) // 2], 4),
-        'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4),
-        'n': len(lat),
-    }
+    """Nearest-rank p50/p99 from the request-latency histogram.
+
+    The deque-era index math (lat[int(n * 0.99)]) under-reported p99
+    at small n; the histogram percentile is the textbook nearest-rank
+    definition, quantized to bucket edges. The old p50_s/p99_s/n keys
+    ride along as aliases for one release."""
+    return self._latency_hist.percentiles()
+
+  def prom_text(self) -> str:
+    """/metricz?format=prom payload: the registry's typed exposition
+    plus the pre-registry faults counters as untyped samples."""
+    return (self.metrics.to_prom('serve')
+            + obs_lib.metrics.prom_counters_text(
+                self.stats()['faults'], tier='serve'))
 
   def stats(self) -> Dict[str, Any]:
     """The faults metrics split: per-request serve counters next to the
@@ -606,18 +637,25 @@ class ConsensusService:
     counters.setdefault('padding_fraction', 0.0)
     with self._lock:
       outstanding = len(self._outstanding)
+    engine_stats = self.engine.stats()
+    for key in tuple(engine_stats):
+      if key in counters:
+        counters[key] = engine_stats.pop(key)
+    registry_view = self.metrics.snapshot()
     out = {
+        # Unified cross-tier schema (docs/observability.md): every tier
+        # exposes tier/ready/draining/outstanding/counters/latency/
+        # histograms at the top level; tier-specific keys nest beside.
+        'tier': 'serve',
         'outstanding': outstanding,
         'draining': self._draining,
         'ready': self.ready,
+        'counters': {**registry_view['counters'], **counters},
+        'histograms': registry_view['histograms'],
         'capacity': self.capacity(),
         'faults': counters,
         'latency': self.latency_percentiles(),
         'outcomes': dataclasses.asdict(self.outcome),
     }
-    engine_stats = self.engine.stats()
-    for key in tuple(engine_stats):
-      if key in counters:
-        counters[key] = engine_stats.pop(key)
     out.update(engine_stats)
     return out

@@ -229,6 +229,13 @@ def fleet_soak(args) -> int:
   env['JAX_PLATFORMS'] = env.get('JAX_PLATFORMS', 'cpu')
   cache_dir = os.path.join(args.out_dir, 'jit_cache')
   os.makedirs(cache_dir, exist_ok=True)
+  # One shared Chrome-trace file for the whole fleet: every tier
+  # (replicas, featurize worker, router) appends spans to it, and the
+  # post-soak connectivity check joins them by trace id.
+  trace_path = os.path.join(args.out_dir, 'fleet_trace.jsonl')
+  if os.path.exists(trace_path):
+    os.unlink(trace_path)
+  env['DCTPU_TRACE'] = trace_path
 
   def spawn_replica():
     return _spawn(
@@ -371,6 +378,7 @@ def fleet_soak(args) -> int:
   # featurize tier; solo-replica polish of the monolithic featurize of
   # the same BAMs is the identity reference.
   bam_ok, bam_mismatch = 0, 0
+  bam_trace_ids = []
   for i in range(3):
     d = os.path.join(args.out_dir, f'fleet_bam_{i}')
     sub_path, ccs_path = write_synthetic_zmw_bams(
@@ -379,7 +387,9 @@ def fleet_soak(args) -> int:
       sub_bytes = f.read()
     with open(ccs_path, 'rb') as f:
       ccs_bytes = f.read()
-    got = router_client.polish_bam(sub_bytes, ccs_bytes, name=f'bam/{i}')
+    bam_trace_ids.append(f'bamleg{i:010d}')
+    got = router_client.polish_bam(sub_bytes, ccs_bytes, name=f'bam/{i}',
+                                   trace_id=bam_trace_ids[-1])
     # Monolithic reference: featurize the exact BAM pair we shipped,
     # polish on a replica directly.
     from deepconsensus_tpu.inference import runner as runner_lib
@@ -420,6 +430,33 @@ def fleet_soak(args) -> int:
     proc.send_signal(signal.SIGTERM)
     tier_rcs.append(proc.wait(timeout=300))
 
+  # Trace connectivity (all tiers have exited, the shared file is
+  # complete): every bam-leg request must form ONE connected trace
+  # whose spans came from at least three distinct processes (router,
+  # featurize worker, model replica), and every verified features-leg
+  # delivery must join its router-minted id across router + replica.
+  from deepconsensus_tpu.obs import summarize as summarize_lib
+  trace_events = summarize_lib.load_trace(trace_path)
+  groups = summarize_lib.trace_groups(trace_events)
+  bam_connected = [len(groups.get(tid, {}).get('pids', ())) >= 3
+                   for tid in bam_trace_ids]
+  n_routed_traces = sum(
+      1 for g in groups.values() if len(g.get('pids', ())) >= 2)
+  # Any dead letter written during the soak must be joinable to its
+  # request's trace.
+  dead_letters_missing_trace = 0
+  for root, _dirs, files in os.walk(args.out_dir):
+    for fn in files:
+      if fn.endswith('.failed.jsonl'):
+        with open(os.path.join(root, fn)) as fh:
+          for line in fh:
+            if line.strip() and 'trace_id' not in json.loads(line):
+              dead_letters_missing_trace += 1
+  trace_connected = (all(bam_connected)
+                     and len(bam_connected) == len(bam_trace_ids)
+                     and n_routed_traces >= n_ok[0]
+                     and dead_letters_missing_trace == 0)
+
   lat = sorted(latencies)
   verdict = {
       'soak': 'fleet',
@@ -441,6 +478,15 @@ def fleet_soak(args) -> int:
       'router_rc': router_rc,
       'router_drained': router_drained,
       'tier_rcs': tier_rcs,
+      'trace': {
+          'path': trace_path,
+          'n_events': len(trace_events),
+          'n_traces': len(groups),
+          'n_routed_traces': n_routed_traces,
+          'bam_connected': bam_connected,
+          'dead_letters_missing_trace': dead_letters_missing_trace,
+      },
+      'trace_connected': trace_connected,
       'wall_s': round(time.time() - t0, 1),
   }
   print(json.dumps(verdict), flush=True)
@@ -454,7 +500,8 @@ def fleet_soak(args) -> int:
         and rolled['old_drained'] and rolled['register_status'] == 200
         and router_rc == 0 and router_drained
         and all(rc == 0 for rc in tier_rcs)
-        and bam_mismatch == 0 and bam_ok > 0)
+        and bam_mismatch == 0 and bam_ok > 0
+        and trace_connected)
   return 0 if ok else 1
 
 

@@ -24,6 +24,7 @@ from tools.dclint import config as dclint_config
 from tools.dclint import core
 from tools.dclint import guarded_by
 from tools.dclint import jit_hazards
+from tools.dclint import registry_writes
 from tools.dclint import shape_literals
 from tools.dclint import typed_faults
 
@@ -598,6 +599,78 @@ class TestShapeLiterals:
     found = findings_for(
         shape_literals, 'deepconsensus_tpu/models/config.py', """\
         max_length = 100
+        """)
+    assert found == []
+
+
+# ---------------------------------------------------------------------------
+# registry-writes
+# ---------------------------------------------------------------------------
+
+
+class TestRegistryWrites:
+
+  PATH = 'deepconsensus_tpu/fleet/router.py'
+
+  def test_catches_subscript_write(self):
+    found = findings_for(registry_writes, self.PATH, """\
+        class Core:
+          def bump(self, key):
+            self._counters[key] += 1
+        """)
+    assert len(found) == 1 and found[0].rule == 'registry-writes'
+
+  def test_catches_subscript_assign(self):
+    found = findings_for(registry_writes, self.PATH, """\
+        class Core:
+          def reset(self, key):
+            self.fault_counters[key] = 0
+        """)
+    assert len(found) == 1
+
+  def test_catches_update_call(self):
+    found = findings_for(registry_writes, self.PATH, """\
+        class Core:
+          def merge(self, other):
+            self._counters.update(other)
+        """)
+    assert len(found) == 1
+
+  def test_allow_comment_suppresses(self):
+    found = findings_for(registry_writes, self.PATH, """\
+        class Core:
+          def bump(self, key):
+            # dclint: allow=registry-writes (migration shim)
+            self._counters[key] += 1
+        """)
+    assert found == []
+
+  def test_reads_and_local_dicts_pass(self):
+    found = findings_for(registry_writes, self.PATH, """\
+        class Core:
+          def stats(self):
+            counters = dict(self._counters)
+            counters['n_requests'] = 1
+            counters.setdefault('n_retries', 0)
+            return counters
+        """)
+    assert found == []
+
+  def test_registry_implementation_exempt(self):
+    found = findings_for(
+        registry_writes, 'deepconsensus_tpu/obs/metrics.py', """\
+        class MetricsRegistry:
+          def counter(self, name):
+            self._counters[name] = object()
+        """)
+    assert found == []
+
+  def test_out_of_scope_file_ignored(self):
+    found = findings_for(
+        registry_writes, 'deepconsensus_tpu/inference/runner.py', """\
+        class R:
+          def f(self):
+            self._counters['x'] += 1
         """)
     assert found == []
 

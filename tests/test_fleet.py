@@ -654,8 +654,7 @@ def test_upstream_draining_503_moves_on_and_marks_draining(params):
     with registry.lock:
       assert (registry._replicas[f'127.0.0.1:{drain_port}'].state
               == ReplicaState.DRAINING)
-    with core._lock:
-      assert core._counters['n_retries'] == 1
+    assert core.obs.counter_values()['n_retries'] == 1
   finally:
     srv.close()
     service.begin_drain()
@@ -753,6 +752,64 @@ def test_router_metricz_aggregates_fleet(fleet, params):
   for r in m['replicas']:
     assert r['in_flight'] == 0
     assert r['n_routed'] == r['n_ok']
+
+
+def test_router_and_worker_prom_endpoints(fleet, params):
+  """All three tiers speak ?format=prom with tier-labeled dctpu_
+  metrics (the replica's is covered in test_serve.py)."""
+  import urllib.request
+
+  f = fleet(n_replicas=1, n_workers=1)
+  rc = f.client()
+  assert rc.wait_ready(10)
+  rc.polish(**_mol(params, 'm/1/ccs'))
+  with urllib.request.urlopen(
+      f'http://127.0.0.1:{f.port}/metricz?format=prom', timeout=10) as r:
+    assert r.headers.get('Content-Type', '').startswith('text/plain')
+    router_text = r.read().decode()
+  assert 'dctpu_n_requests{tier="router"} 1' in router_text
+  wport = f.workers[0][2]
+  with urllib.request.urlopen(
+      f'http://127.0.0.1:{wport}/metricz?format=prom', timeout=10) as r:
+    worker_text = r.read().decode()
+  assert 'tier="featurize"' in worker_text
+  assert 'dctpu_' in worker_text
+
+
+def test_trace_spans_connect_across_tiers(fleet, params, synthetic_bams,
+                                          monkeypatch, tmp_path):
+  """One bam/1 request leaves a connected trace: the router-minted (or
+  client-supplied) trace id appears on the route, featurize, and
+  serve_request spans in the shared trace file."""
+  from deepconsensus_tpu import obs as obs_lib
+  from deepconsensus_tpu.obs import summarize as summarize_lib
+
+  trace_path = str(tmp_path / 'fleet_trace.jsonl')
+  monkeypatch.setenv(obs_lib.trace.ENV_TRACE, trace_path)
+  try:
+    f = fleet(n_replicas=1, n_workers=1)
+    rc = f.client()
+    assert rc.wait_ready(10)
+    sub_path, ccs_path = synthetic_bams(n_zmws=1, n_subreads=3,
+                                        seq_len=120)
+    with open(sub_path, 'rb') as fh:
+      subreads_bam = fh.read()
+    with open(ccs_path, 'rb') as fh:
+      ccs_bam = fh.read()
+    got = rc.polish_bam(subreads_bam, ccs_bam, name='z/1',
+                        trace_id='c0ffeec0ffee0001')
+    assert got['status'] == 'ok'
+  finally:
+    obs_lib.trace.configure(None)
+  events = summarize_lib.load_trace(trace_path)
+  mine = [e for e in events if e.get('ph') == 'X'
+          and e.get('args', {}).get('trace_id') == 'c0ffeec0ffee0001']
+  names = {e['name'] for e in mine}
+  assert 'route' in names            # router leg
+  assert 'featurize' in names        # featurize-worker leg
+  assert 'serve_request' in names    # model-replica leg
+  groups = summarize_lib.trace_groups(events)
+  assert groups['c0ffeec0ffee0001']['n_spans'] >= 3
 
 
 def test_featurize_worker_rejects_multi_molecule_and_garbage(

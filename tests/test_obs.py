@@ -1,0 +1,458 @@
+"""Observability plane suite (deepconsensus_tpu/obs/).
+
+Covers the four obs subsystems in isolation plus their contracts:
+
+  * metrics registry — typed counters/gauges, fixed-bucket histograms
+    with nearest-rank percentiles (the deque-index under-report at
+    small n is the regression test), unified snapshot, Prometheus text
+    exposition;
+  * trace spans — Chrome-trace JSONL framing (one `[` header however
+    many writers share the file, atomic one-line appends), the
+    tracing-off fast path, thread-local trace-id stamping, and the
+    record_stage contract that feeds the SAME measured interval to
+    both the histogram and the span (the reconciliation guarantee
+    bench.py asserts end to end);
+  * summarize — per-stage totals/coverage, critical-path ordering,
+    straggler extraction, span-derived overlap (launch-before-finalize
+    ordering), trace-group connectivity, corrupt-file typing;
+  * profiler — guarded on-demand capture status dicts;
+
+plus the `dctpu trace` CLI and dead-letter trace-id stamping.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu import obs as obs_lib
+from deepconsensus_tpu.obs import metrics as metrics_lib
+from deepconsensus_tpu.obs import profiler as profiler_lib
+from deepconsensus_tpu.obs import summarize as summarize_lib
+from deepconsensus_tpu.obs import trace as trace_lib
+
+
+@pytest.fixture(autouse=True)
+def _reset_trace():
+  """Each test starts and ends with tracing off and no trace id."""
+  trace_lib.configure(None)
+  trace_lib.set_trace_id(None)
+  yield
+  trace_lib.configure(None)
+  trace_lib.set_trace_id(None)
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsRegistry:
+
+  def test_counter_and_gauge(self):
+    reg = metrics_lib.MetricsRegistry(tier='test')
+    reg.inc('n_requests')
+    reg.inc('n_requests', 4)
+    reg.set_gauge('outstanding', 3.5)
+    assert reg.counter_values()['n_requests'] == 5
+    snap = reg.snapshot()
+    assert snap['counters']['n_requests'] == 5
+    assert snap['gauges']['outstanding'] == 3.5
+
+  def test_histogram_observe_and_snapshot(self):
+    reg = metrics_lib.MetricsRegistry()
+    h = reg.histogram('latency_s', bounds=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+      h.observe(v)
+    snap = h.snapshot()
+    assert snap['count'] == 5
+    assert snap['sum'] == pytest.approx(56.05)
+    assert snap['buckets'] == [[0.1, 1], [1.0, 2], [10.0, 1], ['inf', 1]]
+
+  def test_nearest_rank_percentiles_small_n(self):
+    # The old deque implementation indexed int(0.99 * n), which at
+    # n=10 reads the 9th of 10 sorted samples — under-reporting p99.
+    # Nearest-rank picks ceil(0.99 * 10) = the 10th sample's bucket.
+    h = metrics_lib.Histogram('x', threading.Lock(),
+                              bounds=(0.01, 0.1, 1.0, 10.0))
+    for _ in range(9):
+      h.observe(0.005)
+    h.observe(5.0)  # the single slow outlier
+    assert h.percentile(0.99) == 10.0
+    assert h.percentile(0.50) == 0.01
+
+  def test_percentiles_aliases(self):
+    h = metrics_lib.Histogram('x', threading.Lock(), bounds=(1.0,))
+    assert h.percentiles()['p50'] is None
+    h.observe(0.5)
+    p = h.percentiles()
+    assert p['p50'] == p['p50_s'] == 1.0
+    assert p['count'] == p['n'] == 1
+
+  def test_empty_histogram_rejected(self):
+    with pytest.raises(ValueError):
+      metrics_lib.Histogram('x', threading.Lock(), bounds=())
+
+  def test_prom_text(self):
+    reg = metrics_lib.MetricsRegistry(tier='serve')
+    reg.inc('n_requests', 7)
+    reg.set_gauge('outstanding', 2)
+    reg.histogram('latency_s', bounds=(0.1, 1.0)).observe(0.5)
+    text = reg.to_prom()
+    assert 'dctpu_n_requests{tier="serve"} 7' in text
+    assert 'dctpu_outstanding{tier="serve"} 2' in text
+    # Cumulative le buckets plus +Inf, _sum and _count.
+    assert 'dctpu_latency_s_bucket{tier="serve",le="0.1"} 0' in text
+    assert 'dctpu_latency_s_bucket{tier="serve",le="1.0"} 1' in text
+    assert 'dctpu_latency_s_bucket{tier="serve",le="+Inf"} 1' in text
+    assert 'dctpu_latency_s_count{tier="serve"} 1' in text
+
+  def test_prom_counters_text_skips_non_numeric(self):
+    text = metrics_lib.prom_counters_text(
+        {'n_ok': 3, 'inference_dtype': 'float32', 'flag': True},
+        tier='serve')
+    assert 'dctpu_n_ok{tier="serve"} 3' in text
+    assert 'inference_dtype' not in text
+    assert 'flag' not in text
+
+  def test_concurrent_inc(self):
+    reg = metrics_lib.MetricsRegistry()
+    threads = [threading.Thread(
+        target=lambda: [reg.inc('n') for _ in range(1000)])
+        for _ in range(8)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    assert reg.counter_values()['n'] == 8000
+
+
+# ---------------------------------------------------------------------------
+# Trace spans
+# ---------------------------------------------------------------------------
+
+
+class TestTraceSpans:
+
+  def test_off_by_default(self):
+    assert not trace_lib.enabled()
+    # No-ops, no file writes.
+    trace_lib.complete_event('x', 'stage', 0.0, 1.0)
+    with trace_lib.span('x'):
+      pass
+
+  def test_writes_loadable_chrome_trace(self, tmp_path):
+    path = str(tmp_path / 'trace.jsonl')
+    trace_lib.configure(path, tier='run')
+    trace_lib.complete_event('featurize', 'stage', 10.0, 10.5,
+                             {'n_zmws': 3})
+    with trace_lib.span('stitch', n_zmws=3):
+      pass
+    trace_lib.configure(None)
+    raw = open(path).read()
+    assert raw.startswith('[\n')
+    events = summarize_lib.load_trace(path)
+    names = [e['name'] for e in events]
+    assert 'process_name' in names          # tier metadata
+    assert 'featurize' in names and 'stitch' in names
+    feat = next(e for e in events if e['name'] == 'featurize')
+    assert feat['ph'] == 'X'
+    assert feat['ts'] == pytest.approx(10.0 * 1e6)
+    assert feat['dur'] == pytest.approx(0.5 * 1e6)
+    assert feat['args']['n_zmws'] == 3
+
+  def test_single_header_with_multiple_writers(self, tmp_path):
+    # N fleet processes share one file: only the O_CREAT|O_EXCL winner
+    # writes `[`; everyone appends whole-line events.
+    path = str(tmp_path / 'shared.jsonl')
+    w1 = trace_lib.TraceWriter(path, tier='router')
+    w2 = trace_lib.TraceWriter(path, tier='serve')
+    w1.complete_event('route', 'request', 1.0, 0.1)
+    w2.complete_event('serve_request', 'request', 1.05, 0.2)
+    w1.close()
+    w2.close()
+    lines = open(path).read().splitlines()
+    assert lines.count('[') == 1 and lines[0] == '['
+    events = summarize_lib.load_trace(path)
+    names = [e['name'] for e in events]
+    assert 'route' in names and 'serve_request' in names
+    # Both writers announced their tier (in a real fleet each is its
+    # own pid; in-process they collide on pid, so count the events).
+    labels = sorted(e['args']['name'] for e in events
+                    if e['name'] == 'process_name')
+    assert labels == ['dctpu-router', 'dctpu-serve']
+
+  def test_thread_local_trace_id_stamping(self, tmp_path):
+    path = str(tmp_path / 'trace.jsonl')
+    trace_lib.configure(path, tier='run')
+    trace_lib.set_trace_id('aabbccdd00112233')
+    trace_lib.complete_event('stitch', 'stage', 0.0, 1.0)
+    # Explicit arg wins over the thread-local binding.
+    trace_lib.complete_event('stitch', 'stage', 0.0, 1.0,
+                             {'trace_id': 'other'})
+    seen = {}
+
+    def other_thread():
+      trace_lib.complete_event('featurize', 'stage', 0.0, 1.0)
+      seen['done'] = True
+
+    t = threading.Thread(target=other_thread)
+    t.start()
+    t.join()
+    trace_lib.configure(None)
+    events = [e for e in summarize_lib.load_trace(path)
+              if e['ph'] == 'X']
+    ids = [e['args'].get('trace_id') for e in events]
+    assert ids == ['aabbccdd00112233', 'other', None]
+    assert seen['done']
+
+  def test_mint_trace_id(self):
+    a, b = trace_lib.mint_trace_id(), trace_lib.mint_trace_id()
+    assert len(a) == 16 and a != b
+    int(a, 16)  # hex
+
+  def test_configure_from_env(self, tmp_path, monkeypatch):
+    path = str(tmp_path / 'env.jsonl')
+    monkeypatch.setenv(trace_lib.ENV_TRACE, path)
+    assert trace_lib.configure_from_env(tier='serve') is not None
+    assert trace_lib.enabled()
+    monkeypatch.delenv(trace_lib.ENV_TRACE)
+    assert trace_lib.configure_from_env() is None
+    assert not trace_lib.enabled()
+
+
+class TestRecordStage:
+
+  def test_feeds_histogram_and_span_same_interval(self, tmp_path):
+    # The reconciliation guarantee: span totals == histogram sums
+    # because both read the same (t0, t1).
+    path = str(tmp_path / 'trace.jsonl')
+    trace_lib.configure(path, tier='run')
+    reg = metrics_lib.MetricsRegistry()
+    intervals = [(1.0, 1.5), (2.0, 2.25), (3.0, 3.75)]
+    for t0, t1 in intervals:
+      obs_lib.record_stage(reg, trace_lib.STAGE_STITCH, t0, t1, pack=1)
+    trace_lib.configure(None)
+    hist_sum = reg.histogram(
+        obs_lib.stage_histogram_name(trace_lib.STAGE_STITCH)
+    ).snapshot()['sum']
+    events = summarize_lib.load_trace(path)
+    span_sum = sum(e['dur'] for e in events if e.get('ph') == 'X') / 1e6
+    assert hist_sum == pytest.approx(1.5)
+    assert span_sum == pytest.approx(hist_sum, rel=1e-6)
+
+  def test_none_registry_still_emits_span(self, tmp_path):
+    path = str(tmp_path / 'trace.jsonl')
+    trace_lib.configure(path, tier='run')
+    obs_lib.record_stage(None, trace_lib.STAGE_FEATURIZE, 0.0, 0.5)
+    trace_lib.configure(None)
+    events = summarize_lib.load_trace(path)
+    assert any(e.get('name') == 'featurize' for e in events)
+
+  def test_tracing_off_records_histogram_only(self):
+    reg = metrics_lib.MetricsRegistry()
+    obs_lib.record_stage(reg, trace_lib.STAGE_H2D, 0.0, 0.5)
+    snap = reg.histogram(
+        obs_lib.stage_histogram_name(trace_lib.STAGE_H2D)).snapshot()
+    assert snap['count'] == 1
+
+
+# ---------------------------------------------------------------------------
+# Summarize
+# ---------------------------------------------------------------------------
+
+
+def _span(name, ts_s, dur_s, pid=1, cat='stage', **args):
+  return {'name': name, 'cat': cat, 'ph': 'X', 'ts': ts_s * 1e6,
+          'dur': dur_s * 1e6, 'pid': pid, 'tid': 1, 'args': args}
+
+
+class TestSummarize:
+
+  def _pipeline_events(self):
+    ev = [{'name': 'process_name', 'ph': 'M', 'pid': 1, 'tid': 0,
+           'args': {'name': 'dctpu-run'}}]
+    # Two packs: pack 0 launched directly (inside finalize), pack 1
+    # overlapped (launched before its finalize started).
+    ev += [
+        _span('featurize', 0.0, 1.0, n_zmws=10, trace_id='t1'),
+        _span('pack_wait', 1.0, 0.2, bucket=100),
+        _span('h2d_transfer', 1.2, 0.1, pack=0, bucket=100),
+        # pack 0: compute starts AT its finalize start (direct).
+        _span('finalize_drain', 1.3, 0.5, pack=0),
+        _span('device_compute', 1.3, 0.5, pack=0, bucket=100, dp=1,
+              n_rows=64),
+        # pack 1: compute started 1.5, finalize started 1.9 (overlap).
+        _span('h2d_transfer', 1.4, 0.1, pack=1, bucket=100),
+        _span('device_compute', 1.5, 2.0, pack=1, bucket=100, dp=1,
+              n_rows=64),
+        _span('finalize_drain', 1.9, 1.6, pack=1),
+        _span('stitch', 3.5, 0.5, n_zmws=10, trace_id='t1'),
+    ]
+    return ev
+
+  def test_stage_totals_and_counts(self):
+    s = summarize_lib.summarize(self._pipeline_events())
+    assert s['stage_totals_s']['device_compute'] == pytest.approx(2.5)
+    assert s['stage_counts']['device_compute'] == 2
+    assert s['stage_totals_s']['featurize'] == pytest.approx(1.0)
+    assert s['wall_s'] == pytest.approx(4.0)
+    assert s['tiers'] == {1: 'dctpu-run'}
+
+  def test_critical_path_ordering(self):
+    s = summarize_lib.summarize(self._pipeline_events())
+    # device_compute spans [1.3, 1.8] U [1.5, 3.5] -> 2.2s coverage,
+    # the largest single-stage coverage -> top of the critical path.
+    top = s['critical_path'][0]
+    assert top['stage'] == 'device_compute'
+    assert top['coverage_s'] == pytest.approx(2.2)
+    assert top['fraction_of_wall'] == pytest.approx(2.2 / 4.0, abs=1e-3)
+
+  def test_span_overlap_rule(self):
+    overlap = summarize_lib.span_overlap(self._pipeline_events())
+    # pack 0: compute ts == finalize ts -> direct; pack 1: compute ts
+    # strictly before finalize ts -> overlapped.
+    assert overlap['n_packs'] == 2
+    assert overlap['n_overlapped'] == 1
+    assert overlap['n_direct'] == 1
+    assert overlap['span_overlap_fraction'] == 0.5
+
+  def test_overlap_skips_unfinalized_pack(self):
+    events = [_span('device_compute', 0.0, 1.0, pack=9)]
+    overlap = summarize_lib.span_overlap(events)
+    assert overlap['n_packs'] == 0
+
+  def test_stragglers_slowest_decile(self):
+    events = [
+        _span('device_compute', float(i), 0.1 + (0.9 if i == 7 else 0),
+              pack=i, bucket=200, dp=2, n_rows=32)
+        for i in range(10)
+    ]
+    s = summarize_lib.summarize(events)
+    assert len(s['stragglers']) == 1
+    row = s['stragglers'][0]
+    assert row['pack'] == 7 and row['bucket'] == 200 and row['dp'] == 2
+
+  def test_trace_groups_connectivity(self):
+    events = [
+        _span('route', 0.0, 1.0, pid=1, cat='request', trace_id='abc'),
+        _span('featurize', 0.1, 0.5, pid=2, trace_id='abc'),
+        _span('serve_request', 0.6, 0.4, pid=3, cat='request',
+              trace_id='abc'),
+        _span('serve_request', 0.0, 0.1, pid=3, cat='request',
+              trace_id='other'),
+    ]
+    groups = summarize_lib.trace_groups(events)
+    assert groups['abc']['pids'] == [1, 2, 3]
+    assert groups['abc']['n_spans'] == 3
+    assert groups['other']['pids'] == [3]
+
+  def test_empty_trace_is_corrupt(self):
+    with pytest.raises(faults_lib.CorruptInputError):
+      summarize_lib.summarize([])
+
+  def test_corrupt_file_typed(self, tmp_path):
+    p = tmp_path / 'bad.jsonl'
+    p.write_text('[\n{"name": "x", not json}\n')
+    with pytest.raises(faults_lib.CorruptInputError):
+      summarize_lib.load_trace(str(p))
+    with pytest.raises(faults_lib.CorruptInputError):
+      summarize_lib.load_trace(str(tmp_path / 'missing.jsonl'))
+
+  def test_format_summary_renders(self):
+    s = summarize_lib.summarize(self._pipeline_events())
+    text = summarize_lib.format_summary(s)
+    assert 'device_compute' in text
+    assert 'transfer overlap (span-derived)' in text
+    assert 'straggler' in text
+
+
+# ---------------------------------------------------------------------------
+# Profiler
+# ---------------------------------------------------------------------------
+
+
+class TestProfiler:
+
+  def test_capture_returns_status_dict(self, tmp_path):
+    result = profiler_lib.capture_profile(str(tmp_path / 'prof'), 0.1)
+    # On a jax-enabled box the capture succeeds; either way the call
+    # must return a status dict, never raise.
+    assert isinstance(result, dict) and 'ok' in result
+    if result['ok']:
+      assert result['out_dir'] == str(tmp_path / 'prof')
+
+  def test_concurrent_capture_refused(self, tmp_path):
+    assert profiler_lib._capture_lock.acquire(blocking=False)
+    try:
+      result = profiler_lib.capture_profile(str(tmp_path / 'p'), 0.1)
+    finally:
+      profiler_lib._capture_lock.release()
+    assert result['ok'] is False
+    assert 'already running' in result['error']
+
+  def test_install_sigusr2_off_main_thread(self, tmp_path):
+    out = {}
+
+    def worker():
+      out['installed'] = profiler_lib.install_sigusr2(str(tmp_path))
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert out['installed'] is False
+
+
+# ---------------------------------------------------------------------------
+# Dead-letter trace stamping + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestDeadLetterTraceId:
+
+  def test_record_stamps_thread_local_trace_id(self, tmp_path):
+    path = str(tmp_path / 'failed.jsonl')
+    writer = faults_lib.DeadLetterWriter(path)
+    trace_lib.set_trace_id('feedfacefeedface')
+    writer.record('zmw/1', 'featurize', 'ValueError', 'boom', 'dropped')
+    trace_lib.set_trace_id(None)
+    writer.record('zmw/2', 'featurize', 'ValueError', 'boom', 'dropped')
+    writer.close()
+    entries = [json.loads(l) for l in open(path)]
+    assert entries[0]['trace_id'] == 'feedfacefeedface'
+    assert 'trace_id' not in entries[1]
+
+
+class TestTraceCli:
+
+  def _write_trace(self, tmp_path):
+    path = str(tmp_path / 'trace.jsonl')
+    trace_lib.configure(path, tier='run')
+    obs_lib.record_stage(None, trace_lib.STAGE_FEATURIZE, 0.0, 1.0)
+    obs_lib.record_stage(None, trace_lib.STAGE_DEVICE_COMPUTE,
+                         1.0, 2.0, pack=0)
+    obs_lib.record_stage(None, trace_lib.STAGE_FINALIZE, 1.0, 2.1,
+                         pack=0)
+    trace_lib.configure(None)
+    return path
+
+  def test_cli_text_and_json(self, tmp_path, capsys):
+    from deepconsensus_tpu import cli
+
+    path = self._write_trace(tmp_path)
+    assert cli.main(['trace', path]) == 0
+    out = capsys.readouterr().out
+    assert 'featurize' in out and 'device_compute' in out
+    assert cli.main(['trace', path, '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['stage_counts']['featurize'] == 1
+    assert payload['overlap']['n_packs'] == 1
+
+  def test_cli_corrupt_exits_2(self, tmp_path, capsys):
+    from deepconsensus_tpu import cli
+
+    bad = tmp_path / 'bad.jsonl'
+    bad.write_text('{nope\n')
+    assert cli.main(['trace', str(bad)]) == 2
+    assert 'dctpu:' in capsys.readouterr().err

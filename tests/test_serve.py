@@ -459,6 +459,120 @@ def test_client_sabotage_env_hooks(serve_ctx, params, monkeypatch):
 
 
 # ----------------------------------------------------------------------
+# Observability plane: unified /metricz schema, Prometheus exposition,
+# on-demand profiler capture, request trace spans (ISSUE 15)
+
+
+def _http_get(port, path):
+  import urllib.request
+  req = urllib.request.urlopen(
+      f'http://127.0.0.1:{port}{path}', timeout=15)
+  with req as r:
+    return r.status, r.headers.get('Content-Type', ''), r.read()
+
+
+def test_metricz_unified_schema(serve_ctx, params):
+  """Every tier's /metricz leads with the same top-level keys; the old
+  serve-only faults/latency splits ride along as aliases."""
+  ctx = serve_ctx()
+  assert ctx.client.wait_ready(10)
+  ctx.client.polish(**_mol(params, 'm/70/ccs'))
+  m = ctx.client.metricz()
+  for key in ('tier', 'ready', 'draining', 'outstanding', 'counters',
+              'latency', 'histograms'):
+    assert key in m, key
+  assert m['tier'] == 'serve'
+  assert m['counters']['n_requests'] == 1
+  assert 'serve_request_latency_s' in m['histograms']
+  # Nearest-rank percentiles under canonical AND alias keys.
+  lat = m['latency']
+  assert lat['p50'] == lat['p50_s'] and lat['p50'] is not None
+  assert lat['p99'] == lat['p99_s']
+  assert lat['count'] == lat['n'] == 1
+  # Legacy split still answers (one-release alias).
+  assert m['faults']['n_requests'] == 1
+
+
+def test_metricz_prom_format(serve_ctx, params):
+  ctx = serve_ctx()
+  assert ctx.client.wait_ready(10)
+  ctx.client.polish(**_mol(params, 'm/71/ccs'))
+  status, ctype, body = _http_get(ctx.port, '/metricz?format=prom')
+  assert status == 200
+  assert ctype.startswith('text/plain')
+  text = body.decode()
+  assert 'dctpu_n_requests{tier="serve"} 1' in text
+  assert 'dctpu_serve_request_latency_s_bucket{tier="serve",' in text
+  assert 'dctpu_serve_request_latency_s_count{tier="serve"} 1' in text
+
+
+def test_debugz_profile_capture(serve_ctx, params, tmp_path):
+  """/debugz/profile?seconds=N runs a bounded jax.profiler capture in
+  the handler thread and reports a status dict either way."""
+  ctx = serve_ctx()
+  assert ctx.client.wait_ready(10)
+  out_dir = str(tmp_path / 'prof')
+  status, _, body = _http_get(
+      ctx.port, f'/debugz/profile?seconds=0.2&out={out_dir}')
+  result = json.loads(body)
+  assert status in (200, 503)
+  assert 'ok' in result
+  if result['ok']:
+    assert result['out_dir'] == out_dir
+    assert os.path.isdir(out_dir)
+  # Bad seconds param is a 400, not a crash.
+  import urllib.error
+  with pytest.raises(urllib.error.HTTPError) as exc:
+    _http_get(ctx.port, '/debugz/profile?seconds=banana')
+  assert exc.value.code == 400
+
+
+def test_request_trace_spans_and_header_propagation(
+    serve_ctx, params, tmp_path):
+  """A traced replica emits the request's span tree stamped with the
+  trace id minted upstream (carried in the polish protocol header)."""
+  from deepconsensus_tpu import obs as obs_lib
+
+  trace_path = str(tmp_path / 'serve_trace.jsonl')
+  obs_lib.trace.configure(trace_path, tier='serve')
+  try:
+    ctx = serve_ctx()
+    assert ctx.client.wait_ready(10)
+    resp = ctx.client.polish(**_mol(params, 'm/72/ccs'),
+                             trace_id='0123456789abcdef')
+    assert resp['status'] == 'ok'
+  finally:
+    obs_lib.trace.configure(None)
+  from deepconsensus_tpu.obs import summarize as summarize_lib
+  events = summarize_lib.load_trace(trace_path)
+  spans = [e for e in events if e.get('ph') == 'X']
+  req = [e for e in spans if e['name'] == 'serve_request']
+  assert len(req) == 1
+  assert req[0]['args']['trace_id'] == '0123456789abcdef'
+  assert req[0]['args']['zmw'] == 'm/72/ccs'
+  # The stitch leg of the same request carries the same id.
+  stitch = [e for e in spans if e['name'] == 'stitch'
+            and e['args'].get('trace_id') == '0123456789abcdef']
+  assert stitch
+
+
+def test_quarantine_record_carries_trace_id(serve_ctx, params,
+                                            monkeypatch, tmp_path):
+  """Dead-lettered / quarantined requests are joinable to their trace:
+  the failure record carries the request's trace id."""
+  ctx = serve_ctx(on_request_error='ccs-fallback')
+  monkeypatch.setenv(shared_faults.ENV_POISON_WINDOW, 'm/73/')
+  resp = ctx.client.polish(**_mol(params, 'm/73/ccs'),
+                           trace_id='feedfeedfeedfeed')
+  assert resp['status'] == 'fallback'
+  entries = [json.loads(line)
+             for line in open(tmp_path / 'serve.failed.jsonl')]
+  mine = [e for e in entries if e['zmw'] == 'm/73/ccs']
+  assert len(mine) == 1
+  assert mine[0]['trace_id'] == 'feedfeedfeedfeed'
+
+
+# ----------------------------------------------------------------------
 # Data-parallel serving: mesh-backed service vs single-device service
 
 

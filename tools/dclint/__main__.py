@@ -17,7 +17,7 @@ from tools.dclint import core
 
 # Rules whose baseline must stay empty: violations get fixed, not
 # suppressed (see ISSUE 7 acceptance criteria / docs/development.md).
-ZERO_BASELINE_RULES = ('typed-faults', 'guarded-by')
+ZERO_BASELINE_RULES = ('typed-faults', 'guarded-by', 'registry-writes')
 
 
 def default_root() -> str:

@@ -28,6 +28,9 @@ TYPED_FAULTS_SCOPE = (
     'deepconsensus_tpu/serve/',
     'deepconsensus_tpu/fleet/',
     'deepconsensus_tpu/models/data.py',
+    # The observability plane is crossed by every request: a bare raise
+    # in trace/metrics/summarize code takes the data plane down with it.
+    'deepconsensus_tpu/obs/',
 )
 
 # The typed fault taxonomy (deepconsensus_tpu/faults.py plus the
@@ -197,6 +200,9 @@ GUARDED_BY_SCOPE = (
     # The flywheel orchestration dispatch (train/distill drive their
     # own threads through run_training's machinery).
     'deepconsensus_tpu/cli.py',
+    # The metrics registry and trace writer are mutated from every
+    # handler/model/producer thread in a tier process.
+    'deepconsensus_tpu/obs/',
 )
 
 # Attribute initialisers of these types are synchronisation primitives
@@ -214,6 +220,23 @@ MUTATING_METHODS = frozenset({
     'pop', 'popleft', 'remove', 'discard', 'clear', 'setdefault',
     'record',
 })
+
+# ---------------------------------------------------------------------------
+# registry-writes
+# ---------------------------------------------------------------------------
+
+# Modules converted to the obs/ metrics registry: ad-hoc counter-dict
+# writes here are regressions (ISSUE 15).
+REGISTRY_WRITES_SCOPE = (
+    'deepconsensus_tpu/serve/service.py',
+    'deepconsensus_tpu/fleet/router.py',
+    'deepconsensus_tpu/fleet/featurize_worker.py',
+    'deepconsensus_tpu/obs/',
+)
+
+# The registry implementation is the one legitimate owner of counter
+# container writes.
+REGISTRY_WRITES_EXEMPT = ('deepconsensus_tpu/obs/metrics.py',)
 
 # ---------------------------------------------------------------------------
 # shape-literals

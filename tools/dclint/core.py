@@ -207,6 +207,7 @@ def run_lint(root: str,
   # Local imports: the checker modules import core for SourceFile.
   from tools.dclint import guarded_by
   from tools.dclint import jit_hazards
+  from tools.dclint import registry_writes
   from tools.dclint import shape_literals
   from tools.dclint import typed_faults
 
@@ -220,6 +221,7 @@ def run_lint(root: str,
     findings.extend(typed_faults.check(src))
     findings.extend(jit_hazards.check(src))
     findings.extend(guarded_by.check(src))
+    findings.extend(registry_writes.check(src))
     findings.extend(shape_literals.check(src))
   findings.sort(key=lambda f: (f.path, f.line, f.rule))
   assign_fingerprints(findings, sources)

@@ -1,0 +1,79 @@
+"""registry-writes checker: counter writes go through the registry.
+
+The obs/ metrics registry (deepconsensus_tpu/obs/metrics.py) replaced
+the scattered per-tier counter dicts (serve's faults counters, the
+router's ``_counters``, the featurize worker's dict + deque).  This
+rule keeps them from growing back: inside the converted modules, any
+*write* to a ``self.<...counter...>`` attribute — a subscript
+assign/augassign (``self._counters[k] += 1``) or a mutating method
+call (``self._counters.update(...)``) — is flagged.  Increment through
+``MetricsRegistry.inc()`` / ``Counter.inc()`` instead.
+
+Reads (rendering a snapshot into /metricz JSON) and local dict
+assembly (``counters = dict(...)``) are deliberately out of scope:
+the rule polices mutation of shared counter state, not serialization.
+The registry implementation itself (obs/metrics.py) is exempt — it is
+the one legitimate owner of those writes.  Deliberate exceptions carry
+``# dclint: allow=registry-writes (reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.dclint import config
+from tools.dclint import core
+
+RULE = 'registry-writes'
+
+# Mutating-method subset that makes sense on a counter container.
+_MUTATORS = frozenset({
+    'update', 'setdefault', 'add', 'append', 'pop', 'clear',
+    'subtract', 'popitem',
+})
+
+
+def _counter_self_attr(node: ast.AST) -> Optional[str]:
+  """'X' when `node` is `self.X` and X names a counter container."""
+  if (isinstance(node, ast.Attribute)
+      and isinstance(node.value, ast.Name) and node.value.id == 'self'
+      and 'counter' in node.attr.lower()):
+    return node.attr
+  return None
+
+
+def check(src: core.SourceFile) -> List[core.Finding]:
+  if not core.in_scope(src.path, config.REGISTRY_WRITES_SCOPE):
+    return []
+  if core.in_scope(src.path, config.REGISTRY_WRITES_EXEMPT):
+    return []
+  findings: List[core.Finding] = []
+
+  def flag(line: int, attr: str, how: str) -> None:
+    if not src.allowed(RULE, line):
+      findings.append(core.Finding(
+          RULE, src.path, line,
+          f'ad-hoc counter write `self.{attr}` ({how}) bypasses the '
+          'obs metrics registry — use MetricsRegistry.inc()/counter() '
+          'or annotate `# dclint: allow=registry-writes (reason)`'))
+
+  for node in ast.walk(src.tree):
+    # self._counters[k] = v / self._counters[k] += n / del ...[k]
+    if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete)):
+      targets = (node.targets if isinstance(node, ast.Assign)
+                 else [node.target] if isinstance(node, ast.AugAssign)
+                 else node.targets)
+      for tgt in targets:
+        if isinstance(tgt, ast.Subscript):
+          attr = _counter_self_attr(tgt.value)
+          if attr:
+            flag(node.lineno, attr, 'subscript write')
+    # self._counters.update(...) and friends.
+    elif isinstance(node, ast.Call):
+      func = node.func
+      if (isinstance(func, ast.Attribute) and func.attr in _MUTATORS):
+        attr = _counter_self_attr(func.value)
+        if attr:
+          flag(node.lineno, attr, f'.{func.attr}() call')
+  return findings
