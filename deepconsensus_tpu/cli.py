@@ -334,6 +334,63 @@ def _add_route(sub):
   p.add_argument('--upstream_timeout_s', type=float, default=300.0,
                  help='End-to-end budget for one forwarded request.')
   p.add_argument('--max_body_mb', type=int, default=64)
+  p.add_argument('--default_class', default='interactive',
+                 help='Priority class for requests without an '
+                 'X-Dctpu-Class header.')
+  p.add_argument('--class_weight', action='append', default=[],
+                 metavar='CLASS=WEIGHT',
+                 help='Weighted-fair admission share for a priority '
+                 'class; repeatable (default: interactive=4 bulk=1).')
+  p.add_argument('--client_quota', type=int, default=0,
+                 help='Max concurrent requests per client id (429 '
+                 'RESOURCE_EXHAUSTED above it); 0 = unlimited.')
+  p.add_argument('--queue_wait_s', type=float, default=0.0,
+                 help='How long a saturated request may wait its '
+                 'weighted-fair turn before shedding (0 = shed '
+                 'immediately).')
+  p.add_argument('--max_queued_per_class', type=int, default=16,
+                 help='Waiting requests per class before that class '
+                 '(and only that class) sheds.')
+  _add_trace_flag(p)
+
+
+def _add_autoscale(sub):
+  p = sub.add_parser(
+      'autoscale',
+      help='SLO autoscaler: watch a router\'s /metricz and '
+      'spawn/drain serve replicas to hold a p99/queue-depth target, '
+      'replacing preempted replicas.')
+  p.add_argument('--router', required=True, metavar='HOST:PORT',
+                 help='The dctpu route endpoint to watch and register '
+                 'spawned replicas with.')
+  p.add_argument('--tier', default='model', choices=['model', 'featurize'])
+  p.add_argument('--min_replicas', type=int, default=1)
+  p.add_argument('--max_replicas', type=int, default=4)
+  p.add_argument('--target_p99_s', type=float, default=2.0,
+                 help='SLO: scale out while the slo_class p99 exceeds '
+                 'this.')
+  p.add_argument('--target_queue_depth', type=float, default=4.0,
+                 help='Scale out while mean READY-replica queue depth '
+                 'exceeds this.')
+  p.add_argument('--slo_class', default='interactive',
+                 help='Priority class whose p99 drives scaling.')
+  p.add_argument('--poll_interval_s', type=float, default=1.0)
+  p.add_argument('--scale_out_cooldown_s', type=float, default=5.0)
+  p.add_argument('--scale_in_cooldown_s', type=float, default=60.0)
+  p.add_argument('--spawn_ready_timeout_s', type=float, default=180.0,
+                 help='How long a spawned replica may take to print '
+                 'its ready line (first spawn pays the jit compile; '
+                 'later ones hit the shared compilation cache).')
+  p.add_argument('--serve_arg', action='append', default=[],
+                 metavar='ARG',
+                 help='Extra argv token for spawned `dctpu serve` '
+                 'replicas; repeatable (e.g. --serve_arg=--random_init '
+                 '--serve_arg=--compilation_cache_dir=/ramdisk/cc). '
+                 'Spawns always get --host 127.0.0.1 --port 0.')
+  p.add_argument('--leave_managed', action='store_true',
+                 help='On exit, leave spawned replicas serving instead '
+                 'of draining them (an autoscaler restart then adopts '
+                 'nothing but the fleet stays up).')
   _add_trace_flag(p)
 
 
@@ -633,6 +690,7 @@ def build_parser() -> argparse.ArgumentParser:
   _add_run(sub)
   _add_serve(sub)
   _add_route(sub)
+  _add_autoscale(sub)
   _add_featurize_worker(sub)
   _add_validate(sub)
   _add_lint(sub)
@@ -869,6 +927,15 @@ def _dispatch(args) -> int:
     if not args.replica and not args.featurize_worker:
       raise ValueError(
           'route needs at least one --replica or --featurize_worker')
+    class_weights = None
+    if args.class_weight:
+      class_weights = {}
+      for spec in args.class_weight:
+        name, sep, weight = spec.partition('=')
+        if not sep:
+          raise ValueError(
+              f'--class_weight expects CLASS=WEIGHT, got {spec!r}')
+        class_weights[name] = float(weight)
     options = router_lib.RouterOptions(
         max_body_bytes=args.max_body_mb << 20,
         io_timeout_s=args.io_timeout_s,
@@ -876,6 +943,11 @@ def _dispatch(args) -> int:
         probe_interval_s=args.probe_interval_s,
         max_inflight=args.max_inflight,
         max_attempts=args.max_attempts,
+        class_weights=class_weights,
+        default_class=args.default_class,
+        client_quota=args.client_quota,
+        queue_wait_s=args.queue_wait_s,
+        max_queued_per_class=args.max_queued_per_class,
     )
     stats = router_lib.route_main(
         replicas=args.replica,
@@ -886,6 +958,114 @@ def _dispatch(args) -> int:
     print(json.dumps({'event': 'drained', **stats}, default=str),
           flush=True)
     return 0 if stats.get('drained') else 1
+
+  if args.command == 'autoscale':
+    import json
+    import signal as signal_lib
+    import subprocess
+    import threading
+    import time
+
+    from deepconsensus_tpu import obs as obs_lib
+    from deepconsensus_tpu.fleet import autoscaler as autoscaler_lib
+    from deepconsensus_tpu.serve.client import ServeClient
+    from deepconsensus_tpu.serve.server import _StopFlag
+
+    obs_lib.trace.configure_from_env(tier='autoscaler')
+    router_host, _, router_port = args.router.partition(':')
+    router_client = ServeClient(
+        router_host or '127.0.0.1', int(router_port), timeout=10.0)
+    subcommand = 'serve' if args.tier == 'model' else 'featurize-worker'
+    procs = {}  # url -> Popen; only the autoscale loop thread touches it
+    all_procs = []  # every Popen ever spawned, for final reaping
+
+    def spawn():
+      cmd = ([sys.executable, '-m', 'deepconsensus_tpu.cli', subcommand,
+              '--host', '127.0.0.1', '--port', '0']
+             + list(args.serve_arg))
+      proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+      deadline = time.monotonic() + args.spawn_ready_timeout_s
+      info = None
+      while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+          raise RuntimeError(
+              f'spawned {subcommand} replica exited rc={proc.poll()} '
+              'before its ready line')
+        try:
+          parsed = json.loads(line)
+        except ValueError:
+          continue
+        if parsed.get('event') == 'ready':
+          info = parsed
+          break
+      if info is None:
+        proc.kill()
+        raise RuntimeError(
+            f'spawned {subcommand} replica not ready within '
+            f'{args.spawn_ready_timeout_s}s')
+      url = f'127.0.0.1:{info["port"]}'
+      status, body, _ = router_client._request(
+          'POST', '/v1/register',
+          body=json.dumps({'url': url, 'tier': args.tier}).encode(),
+          headers={'Content-Type': 'application/json'})
+      if status != 200:
+        proc.terminate()
+        raise RuntimeError(
+            f'router register of {url} failed: HTTP {status} '
+            f'{body[:200].decode("latin-1")}')
+      procs[url] = proc
+      all_procs.append(proc)
+      print(json.dumps({'event': 'spawned', 'url': url,
+                        'tier': args.tier}), flush=True)
+      return url
+
+    def drain(url):
+      proc = procs.pop(url, None)
+      if proc is None or proc.poll() is not None:
+        return
+      proc.send_signal(signal_lib.SIGTERM)
+      # Reap off-thread: the SIGTERM drain may take max_deadline_s and
+      # must not stall the control loop.
+      threading.Thread(target=proc.wait, daemon=True).start()
+
+    options = autoscaler_lib.AutoscalerOptions(
+        tier=args.tier,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        target_p99_s=args.target_p99_s,
+        target_queue_depth=args.target_queue_depth,
+        slo_class=args.slo_class,
+        poll_interval_s=args.poll_interval_s,
+        scale_out_cooldown_s=args.scale_out_cooldown_s,
+        scale_in_cooldown_s=args.scale_in_cooldown_s,
+    )
+    scaler = autoscaler_lib.Autoscaler(
+        options, fetch_stats=router_client.metricz,
+        spawn_fn=spawn, drain_fn=drain,
+        on_decision=lambda d: d['action'] not in ('hold',) and print(
+            json.dumps({'event': 'autoscale', **d}), flush=True))
+    stop = _StopFlag()
+    stop.install()
+    print(json.dumps({'event': 'ready', 'router': args.router,
+                      'tier': args.tier,
+                      'min': args.min_replicas,
+                      'max': args.max_replicas}), flush=True)
+    try:
+      scaler.run(stop_event=stop.event)
+    finally:
+      stop.restore()
+      scaler.shutdown(drain_managed=not args.leave_managed)
+      if not args.leave_managed:
+        for proc in all_procs:
+          try:
+            proc.wait(timeout=60)
+          except subprocess.TimeoutExpired:
+            proc.kill()
+    stats = scaler.stats()
+    print(json.dumps({'event': 'drained', **stats}, default=str),
+          flush=True)
+    return 0
 
   if args.command == 'featurize-worker':
     import json

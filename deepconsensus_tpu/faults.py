@@ -171,6 +171,23 @@ class ReplicaLostError(FleetRejection):
   http_status = 503
 
 
+class QuotaExceededError(FleetRejection):
+  """A client is at its per-client concurrent-request quota at the
+  router's multi-tenant admission gate. Transient by construction
+  (RESOURCE_EXHAUSTED): the quota frees as the client's own in-flight
+  requests complete, so a well-behaved client retries with backoff —
+  but unlike BackpressureError this rejection is attributable to ONE
+  tenant, never to fleet capacity, so the shed cannot starve other
+  clients."""
+
+  http_status = 429
+
+  def __init__(self, detail: str):
+    # Skip FleetRejection's UNAVAILABLE prefix: quota exhaustion is the
+    # client's own concurrency, not fleet capacity.
+    ServeRejection.__init__(self, f'RESOURCE_EXHAUSTED: {detail}')
+
+
 class CrashLoopError(RuntimeError):
   """Raised by run_training_with_retry when restarts stop making
   progress: the same resume step across K consecutive transient
@@ -426,6 +443,13 @@ ENV_DEVICE_HANG_S = 'DCTPU_FAULT_DEVICE_HANG_S'
 # `dctpu train --on_device_error=degrade` must rebuild the mesh one dp
 # step down mid-run.
 ENV_DEVICE_LOST_AT_STEP = 'DCTPU_FAULT_DEVICE_LOST_AT_STEP'
+# Preemption-notice hook (`inject_faults.py preempt` / soak drills):
+# a serve replica started with this set delivers itself a preemption
+# notice after the given number of seconds — /readyz flips to 503
+# draining, admissions stop, in-flight requests finish, and the
+# process exits cleanly, exactly as if SIGUSR1 had arrived from the
+# cloud provider's preemption agent. Fractional seconds allowed.
+ENV_PREEMPT_AT_S = 'DCTPU_FAULT_PREEMPT_AT_S'
 
 # Hooks that already fired in this process (consume-once semantics:
 # after a NaN-sentinel rollback the training loop passes the same step
@@ -559,6 +583,21 @@ def injected_device_hang(pack_ordinal: int) -> float:
   log.warning('fault injection: device hang %.1fs at pack %d',
               hang_s, pack_ordinal)
   return hang_s
+
+
+def preempt_notice_after_s() -> float:
+  """Seconds after serve start at which the replica should deliver
+  itself a preemption notice (0.0 = hook unarmed). The serve lifecycle
+  (serve/server.py _PreemptionWatch) arms a timer with this value so
+  the notice fires without any external agent — the deterministic
+  in-process analog of the SIGUSR1 a real preemption agent sends."""
+  raw = os.environ.get(ENV_PREEMPT_AT_S, '')
+  if not raw:
+    return 0.0
+  try:
+    return max(0.0, float(raw))
+  except ValueError:
+    return 0.0
 
 
 def maybe_kill_shard_reader(shard_path: str) -> None:

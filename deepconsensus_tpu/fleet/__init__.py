@@ -7,9 +7,12 @@ prefill/decode disaggregation — accelerator replicas run nothing but
 dispatch/finalize).
 
   registry.py          health-gated replica registration + probing
-  balancer.py          weighted least-loaded pick, bounded in-flight
+  balancer.py          weighted least-loaded pick, bounded in-flight,
+                       weighted-fair multi-tenant admission
   router.py            `dctpu route`: the /v1/polish front tier
   featurize_worker.py  `dctpu featurize-worker`: bam/1 -> features/1
+  autoscaler.py        `dctpu autoscale`: SLO-driven replica target
+                       reconciliation + preemption replacement
 """
 from deepconsensus_tpu.fleet.registry import (  # noqa: F401
     FEATURIZE_TIER,
@@ -20,4 +23,8 @@ from deepconsensus_tpu.fleet.registry import (  # noqa: F401
 )
 from deepconsensus_tpu.fleet.balancer import (  # noqa: F401
     LeastLoadedBalancer,
+)
+from deepconsensus_tpu.fleet.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerOptions,
 )

@@ -1,0 +1,307 @@
+"""SLO autoscaler: `dctpu autoscale` — the fleet's reconciliation loop.
+
+Watches the router's unified /metricz and holds a per-tier replica
+target so the configured SLO holds while paying for no more replicas
+than the load needs:
+
+  * scale OUT when the SLO-class p99 (falling back to the tier p99
+    when the class has no samples yet) exceeds target_p99_s, or the
+    mean READY-replica queue depth exceeds target_queue_depth. Spawns
+    are cheap: every replica shares the persistent compilation cache,
+    so a new one warms in seconds, not minutes.
+  * scale IN when both signals sit well under target (scale_in_fraction)
+    for a full cooldown. Scale-in only ever drains replicas THIS
+    autoscaler spawned (the managed ledger) — operator-started
+    replicas are never touched — and riding the SIGTERM drain
+    contract means zero accepted requests are lost.
+  * REPLACE whenever live (READY+JOINING) count drops under target:
+    a preempted/dead/draining replica falls out of the live set and
+    the deficit is respawned next tick. This is the preemption story:
+    the notice (SIGUSR1 / DCTPU_FAULT_PREEMPT_AT_S) flips the doomed
+    replica to DRAINING, the router stops routing to it, and the
+    autoscaler restores capacity before the hard kill lands.
+
+Asymmetric cooldowns (fast out, slow in) are deliberate: a missed
+scale-out burns the SLO now, a missed scale-in burns only money.
+
+The controller is transport-agnostic: `fetch_stats` / `spawn_fn` /
+`drain_fn` are injected, so tests drive pure decision sequences and
+the CLI binds them to HTTP + subprocesses. Every tick emits an
+`autoscale_decision` span into the shared fleet trace (DCTPU_TRACE)
+and counts decisions in its own MetricsRegistry.
+
+stdlib-only (no jax): the autoscaler runs on any coordinator box.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from deepconsensus_tpu import obs as obs_lib
+from deepconsensus_tpu.fleet import registry as registry_lib
+
+log = logging.getLogger(__name__)
+
+# Live = will take (or soon take) traffic; DRAINING and DEAD replicas
+# are on their way out and count as capacity already lost.
+_LIVE_STATES = (registry_lib.ReplicaState.READY,
+                registry_lib.ReplicaState.JOINING)
+
+
+@dataclasses.dataclass
+class AutoscalerOptions:
+  tier: str = registry_lib.MODEL_TIER
+  min_replicas: int = 1
+  max_replicas: int = 4
+  # SLO signals: the p99 of slo_class (per-class histogram on the
+  # router) and the mean queue depth across READY replicas of tier.
+  target_p99_s: float = 2.0
+  target_queue_depth: float = 4.0
+  slo_class: str = 'interactive'
+  poll_interval_s: float = 1.0
+  scale_out_cooldown_s: float = 5.0
+  scale_in_cooldown_s: float = 60.0
+  # Scale in only when p99 AND queue depth sit under this fraction of
+  # their targets — hysteresis so the fleet doesn't saw-tooth around
+  # the threshold.
+  scale_in_fraction: float = 0.5
+
+
+class Autoscaler:
+  """One reconciliation loop instance.
+
+  fetch_stats() -> the router's /metricz dict (raising on transport
+    failure is fine: the tick is skipped and counted).
+  spawn_fn() -> url of a freshly spawned, router-registered replica.
+  drain_fn(url) -> initiates the SIGTERM drain of a managed replica.
+
+  tick() is the whole control law; run() loops it. State is
+  lock-guarded because the CLI lifecycle thread (stop/shutdown) and
+  the loop thread both touch the ledger."""
+
+  def __init__(self, options: AutoscalerOptions,
+               fetch_stats: Callable[[], Dict[str, Any]],
+               spawn_fn: Callable[[], str],
+               drain_fn: Callable[[str], None],
+               on_decision: Optional[Callable[[Dict[str, Any]], None]]
+               = None):
+    self.options = options
+    self.fetch_stats = fetch_stats
+    self.spawn_fn = spawn_fn
+    self.drain_fn = drain_fn
+    self.on_decision = on_decision
+    self.obs = obs_lib.MetricsRegistry(tier='autoscaler')
+    for key in ('n_ticks', 'n_poll_errors', 'n_scale_out', 'n_scale_in',
+                'n_replaced', 'n_spawned', 'n_drained', 'n_spawn_errors'):
+      self.obs.counter(key)
+    self._lock = threading.Lock()
+    self._stop = threading.Event()
+    self.target = max(0, options.min_replicas)  # guarded by: self._lock
+    self._managed: List[str] = []  # guarded by: self._lock
+    # Cooldown anchors: the first scale-out is never gated (an SLO
+    # breach at startup is real), but the first scale-in waits a full
+    # cooldown from start — the fleet must prove it is cold, not just
+    # be observed before traffic arrives.
+    self._last_out_s = float('-inf')  # guarded by: self._lock
+    self._last_in_s = time.monotonic()  # guarded by: self._lock
+    self._last_decision: Dict[str, Any] = {}  # guarded by: self._lock
+
+  # -- signal extraction -------------------------------------------------
+
+  def _signals(self, stats: Dict[str, Any]) -> Dict[str, Any]:
+    opts = self.options
+    replicas = [r for r in stats.get('replicas', [])
+                if r.get('tier') == opts.tier]
+    ready = [r for r in replicas if r.get('state')
+             == registry_lib.ReplicaState.READY]
+    n_live = sum(1 for r in replicas if r.get('state') in _LIVE_STATES)
+    p99 = None
+    class_lat = stats.get('class_latency', {}).get(opts.slo_class, {})
+    if class_lat.get('p99') is not None:
+      p99 = float(class_lat['p99'])
+    else:
+      tier_lat = stats.get('latency', {}).get(opts.tier, {})
+      if tier_lat.get('p99') is not None:
+        p99 = float(tier_lat['p99'])
+    queue_depth = (sum(int(r.get('queue_depth', 0) or 0) for r in ready)
+                   / len(ready)) if ready else 0.0
+    return {
+        'replicas': replicas,
+        'n_live': n_live,
+        'n_ready': len(ready),
+        'p99': p99,
+        'queue_depth': round(queue_depth, 3),
+    }
+
+  # -- control law -------------------------------------------------------
+
+  def tick(self) -> Dict[str, Any]:
+    """One reconcile step. Returns the decision record (also stored,
+    traced, and handed to on_decision)."""
+    opts = self.options
+    t0 = time.time()
+    self.obs.inc('n_ticks')
+    try:
+      stats = self.fetch_stats()
+    # dclint: allow=typed-faults (a poll failure only skips this tick;
+    # the router being briefly unreachable must not kill the loop)
+    except Exception as e:  # noqa: BLE001
+      self.obs.inc('n_poll_errors')
+      decision = {'action': 'poll_error', 'reason': f'{type(e).__name__}: {e}'}
+      self._finish(decision, t0)
+      return decision
+    sig = self._signals(stats)
+    now = time.monotonic()
+    hot = ((sig['p99'] is not None and sig['p99'] > opts.target_p99_s)
+           or sig['queue_depth'] > opts.target_queue_depth)
+    cold = ((sig['p99'] is None
+             or sig['p99'] < opts.target_p99_s * opts.scale_in_fraction)
+            and sig['queue_depth']
+            < opts.target_queue_depth * opts.scale_in_fraction)
+    action, reason = 'hold', 'within SLO at target capacity'
+    drain_url = None
+    with self._lock:
+      # Prune managed urls that no longer exist or died out from under
+      # us (externally killed): they are not drainable on shutdown.
+      known = {r['url']: r.get('state') for r in sig['replicas']}
+      self._managed = [
+          u for u in self._managed
+          if known.get(u) not in (None, registry_lib.ReplicaState.DEAD)
+      ]
+      pre_deficit = self.target - sig['n_live']
+      if hot and self.target < opts.max_replicas and \
+          now - self._last_out_s >= opts.scale_out_cooldown_s:
+        self.target += 1
+        self._last_out_s = now
+        self.obs.inc('n_scale_out')
+        action = 'scale_out'
+        reason = (f'p99={sig["p99"]} > {opts.target_p99_s}s or '
+                  f'queue={sig["queue_depth"]} > '
+                  f'{opts.target_queue_depth}')
+      elif cold and self.target > opts.min_replicas \
+          and sig['n_live'] >= self.target \
+          and now - self._last_in_s >= opts.scale_in_cooldown_s:
+        self.target -= 1
+        self._last_in_s = now
+        self.obs.inc('n_scale_in')
+        action = 'scale_in'
+        reason = (f'p99={sig["p99"]} and queue={sig["queue_depth"]} '
+                  f'under {opts.scale_in_fraction}x target for a full '
+                  'cooldown')
+        # Only a replica from the managed ledger is ever drained; the
+        # newest goes first (operator-started replicas are the base).
+        for url in reversed(self._managed):
+          if known.get(url) in _LIVE_STATES:
+            drain_url = url
+            self._managed.remove(url)
+            break
+      deficit = self.target - sig['n_live']
+      target = self.target
+    if drain_url is not None:
+      log.info('autoscale: draining %s (%s)', drain_url, reason)
+      self.drain_fn(drain_url)
+      self.obs.inc('n_drained')
+    spawned = []
+    for _ in range(max(0, deficit)):
+      try:
+        url = self.spawn_fn()
+      # dclint: allow=typed-faults (one failed spawn must not kill the
+      # control loop; the deficit persists and next tick retries)
+      except Exception as e:  # noqa: BLE001
+        self.obs.inc('n_spawn_errors')
+        log.error('autoscale: spawn failed: %s', e)
+        break
+      spawned.append(url)
+      self.obs.inc('n_spawned')
+      with self._lock:
+        self._managed.append(url)
+    if spawned and action == 'hold':
+      action = 'replace'
+      reason = (f'live={sig["n_live"]} < target={target}: restoring '
+                'capacity lost to preemption/death')
+    if spawned and pre_deficit > 0:
+      # Spawns that cover a pre-existing live deficit (not the slot a
+      # scale_out just added) are replacements.
+      self.obs.inc('n_replaced', min(len(spawned), pre_deficit))
+    self.obs.set_gauge('target_replicas', target)
+    self.obs.set_gauge('live_replicas', sig['n_live'])
+    decision = {
+        'action': action,
+        'tier': opts.tier,
+        'reason': reason,
+        'p99': sig['p99'],
+        'queue_depth': sig['queue_depth'],
+        'n_live': sig['n_live'],
+        'n_ready': sig['n_ready'],
+        'target': target,
+        'spawned': spawned,
+        'drained': drain_url,
+    }
+    self._finish(decision, t0)
+    return decision
+
+  def _finish(self, decision: Dict[str, Any], t0: float) -> None:
+    with self._lock:
+      self._last_decision = dict(decision)
+    obs_lib.trace.complete_event(
+        'autoscale_decision', 'autoscaler', t0, time.time(), decision)
+    if self.on_decision is not None:
+      self.on_decision(decision)
+
+  # -- lifecycle ---------------------------------------------------------
+
+  def run(self, stop_event: Optional[threading.Event] = None) -> None:
+    """Ticks until stop() (or stop_event) is set. Runs on the caller's
+    thread — the CLI owns signal handling around it."""
+    while not self._stop.is_set():
+      if stop_event is not None and stop_event.is_set():
+        return
+      self.tick()
+      if self._stop.wait(timeout=self.options.poll_interval_s):
+        return
+      if stop_event is not None and stop_event.is_set():
+        return
+
+  def stop(self) -> None:
+    self._stop.set()
+
+  def shutdown(self, drain_managed: bool = False) -> List[str]:
+    """Stops the loop; with drain_managed, SIGTERM-drains every
+    replica this autoscaler spawned (the default leaves them serving —
+    an autoscaler restart must not take the fleet down with it)."""
+    self.stop()
+    with self._lock:
+      managed = list(self._managed)
+      if drain_managed:
+        self._managed = []
+    if drain_managed:
+      for url in managed:
+        try:
+          self.drain_fn(url)
+          self.obs.inc('n_drained')
+        # dclint: allow=typed-faults (best-effort teardown: a replica
+        # that already died mid-drain is the desired end state)
+        except Exception as e:  # noqa: BLE001
+          log.warning('autoscale: drain of %s failed: %s', url, e)
+    return managed
+
+  # -- views -------------------------------------------------------------
+
+  def stats(self) -> Dict[str, Any]:
+    registry_view = self.obs.snapshot()
+    with self._lock:
+      managed = list(self._managed)
+      target = self.target
+      last = dict(self._last_decision)
+    return {
+        # Unified cross-tier schema (docs/observability.md).
+        'tier': 'autoscaler',
+        'counters': registry_view['counters'],
+        'gauges': registry_view['gauges'],
+        'target': target,
+        'managed': managed,
+        'last_decision': last,
+    }

@@ -204,15 +204,13 @@ class FeaturizeService:
     with self._lock:
       in_flight = self._in_flight
     return {
-        # Unified cross-tier schema (docs/observability.md); 'faults'
-        # stays as a legacy alias of counters.
+        # Unified cross-tier schema (docs/observability.md).
         'tier': 'featurize',
         'outstanding': in_flight,
         'draining': self._draining,
         'ready': self.ready,
         'counters': counters,
         'histograms': registry_view['histograms'],
-        'faults': counters,
         'latency': self._latency_hist.percentiles(),
     }
 

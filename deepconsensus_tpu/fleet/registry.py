@@ -10,14 +10,21 @@ signals fresh from endpoints the serve stack already exposes:
             no new work while it finishes its admitted requests — the
             rolling-restart handshake.
   /metricz  outstanding (queue depth), transfer_overlap_fraction, and
-            the full faults counter split, cached per replica so the
+            the full unified counter split, cached per replica so the
             router's /metricz can aggregate the fleet without fanning
             out a probe per scrape.
 
 Connection-level probe failures accumulate; dead_after consecutive
 failures park the replica in DEAD. DEAD replicas keep being probed —
-a restarted replica on the same address heals back to READY on its
-first good probe, so a static fleet config survives rolling restarts.
+a restarted replica on the same address heals back to READY, so a
+static fleet config survives rolling restarts.
+
+Healing has hysteresis: after a DEAD verdict, READY requires
+ready_after CONSECUTIVE healthy probes (any missed probe resets the
+streak). A replica flapping between alive and dead therefore never
+re-enters the balancer's candidate set mid-flap — without the streak
+requirement a flapper would thrash the balancer, absorbing a request
+on each one-probe revival and losing it on the next flap.
 """
 from __future__ import annotations
 
@@ -58,6 +65,11 @@ class Replica:
   overlap_fraction: float = 0.0
   in_flight: int = 0
   probe_failures: int = 0
+  # Probe hysteresis: healing=True after a DEAD verdict until the
+  # replica earns ready_after consecutive healthy probes; heal_streak
+  # counts them (reset by any missed probe).
+  healing: bool = False
+  heal_streak: int = 0
   last_probe_s: float = 0.0
   n_routed: int = 0
   n_ok: int = 0
@@ -78,10 +90,12 @@ class ReplicaRegistry:
   its in-flight increment are one atomic step."""
 
   def __init__(self, probe_interval_s: float = 0.5,
-               probe_timeout_s: float = 5.0, dead_after: int = 3):
+               probe_timeout_s: float = 5.0, dead_after: int = 3,
+               ready_after: int = 2):
     self.probe_interval_s = probe_interval_s
     self.probe_timeout_s = probe_timeout_s
     self.dead_after = dead_after
+    self.ready_after = max(1, ready_after)
     self._lock = threading.Lock()
     self._replicas: Dict[str, Replica] = {}  # guarded by: self._lock
     self._stop = threading.Event()
@@ -112,6 +126,10 @@ class ReplicaRegistry:
       else:
         replica.state = ReplicaState.JOINING
         replica.probe_failures = 0
+        # Explicit re-registration is operator intent (rolling-restart
+        # rejoin): it clears the hysteresis debt a DEAD spell accrued.
+        replica.healing = False
+        replica.heal_streak = 0
       return dataclasses.replace(replica)
 
   def remove(self, url: str) -> bool:
@@ -159,8 +177,10 @@ class ReplicaRegistry:
           return
         replica.probe_failures += 1
         replica.last_probe_s = time.monotonic()
+        replica.heal_streak = 0  # any missed probe breaks the streak
         if replica.probe_failures >= self.dead_after:
           replica.state = ReplicaState.DEAD
+          replica.healing = True
       return
     with self._lock:
       replica = self._replicas.get(url)
@@ -171,19 +191,33 @@ class ReplicaRegistry:
       replica.mesh_dp = int(ready.get('mesh_dp', 0) or 1)
       replica.degraded = bool(ready.get('degraded', False))
       replica.queue_depth = int(stats.get('outstanding', 0) or 0)
-      faults = stats.get('faults', {})
+      counters = stats.get('counters', {})
       replica.overlap_fraction = float(
-          faults.get('transfer_overlap_fraction', 0.0) or 0.0)
+          counters.get('transfer_overlap_fraction', 0.0) or 0.0)
       replica.counters = {
-          k: v for k, v in faults.items() if isinstance(v, (int, float))
+          k: v for k, v in counters.items() if isinstance(v, (int, float))
       }
       if ready.get('ready'):
-        replica.state = ReplicaState.READY
+        if replica.healing:
+          # Hysteresis: a replica coming back from DEAD must answer
+          # ready_after consecutive healthy probes before it re-enters
+          # the candidate set — one good probe from a flapper is noise.
+          replica.heal_streak += 1
+          if replica.heal_streak >= self.ready_after:
+            replica.healing = False
+            replica.heal_streak = 0
+            replica.state = ReplicaState.READY
+          else:
+            replica.state = ReplicaState.JOINING
+        else:
+          replica.state = ReplicaState.READY
       elif ready.get('draining'):
+        replica.heal_streak = 0
         replica.state = ReplicaState.DRAINING
       else:
         # Alive but not ready (warming after restart): back to the
         # health gate; no new work until /readyz goes green.
+        replica.heal_streak = 0
         replica.state = ReplicaState.JOINING
 
   # -- router-observed events -------------------------------------------
@@ -198,6 +232,8 @@ class ReplicaRegistry:
         replica.probe_failures = max(replica.probe_failures,
                                      self.dead_after)
         replica.state = ReplicaState.DEAD
+        replica.healing = True
+        replica.heal_streak = 0
 
   def mark_draining(self, url: str) -> None:
     """The router saw a draining 503 from this replica before the next

@@ -41,6 +41,7 @@ import http.client
 import io
 import json
 import logging
+import re
 import threading
 import time
 import socket
@@ -51,6 +52,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from deepconsensus_tpu import faults as shared_faults
 from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.fleet import registry as registry_lib
+from deepconsensus_tpu.fleet import balancer as balancer_lib
 from deepconsensus_tpu.fleet.balancer import LeastLoadedBalancer
 from deepconsensus_tpu.serve import protocol
 from deepconsensus_tpu.serve.server import _DeadlineSocketIO, _StopFlag
@@ -58,6 +60,7 @@ from deepconsensus_tpu.serve.server import _DeadlineSocketIO, _StopFlag
 log = logging.getLogger(__name__)
 
 _RETRYABLE_UPSTREAM = (429, 503)  # explicit refusal: request not accepted
+_CLASS_RE = re.compile(r'^[a-z0-9_-]{1,32}$')
 
 
 @dataclasses.dataclass
@@ -70,6 +73,16 @@ class RouterOptions:
   max_inflight: int = 8              # per replica, scaled by mesh_dp
   max_attempts: int = 3              # distinct replicas tried per request
   latency_window: int = 2048         # per-tier latency samples retained
+  # Multi-tenant QoS (balancer.py): class weights for weighted-fair
+  # admission, the class unlabeled requests land in, the per-client
+  # concurrent-request quota (0 = unlimited), how long a saturated
+  # acquire may wait its weighted-fair turn (0 = shed immediately,
+  # the pre-QoS behavior), and the per-class waiter bound.
+  class_weights: Optional[Dict[str, float]] = None
+  default_class: str = balancer_lib.DEFAULT_CLASS
+  client_quota: int = 0
+  queue_wait_s: float = 0.0
+  max_queued_per_class: int = 16
 
 
 class _SendPhaseError(OSError):
@@ -100,7 +113,12 @@ class RouterCore:
     self.registry = registry
     self.options = options or RouterOptions()
     self.balancer = LeastLoadedBalancer(
-        registry, max_inflight=self.options.max_inflight)
+        registry, max_inflight=self.options.max_inflight,
+        class_weights=self.options.class_weights,
+        default_class=self.options.default_class,
+        client_quota=self.options.client_quota,
+        queue_wait_s=self.options.queue_wait_s,
+        max_queued_per_class=self.options.max_queued_per_class)
     self._lock = threading.Lock()
     # Central metrics registry (obs/metrics.py): counters pre-created
     # so /metricz always exposes the full set, per-tier forwarding
@@ -109,7 +127,7 @@ class RouterCore:
     for key in ('n_requests', 'n_routed_model', 'n_routed_featurize',
                 'n_retries', 'n_rejected_saturated', 'n_replica_lost',
                 'n_bad_requests', 'n_upstream_rejects_relayed',
-                'n_registered'):
+                'n_registered', 'n_quota_rejected'):
       self.obs.counter(key)
     self._tier_hists = {
         tier: self.obs.histogram(
@@ -117,6 +135,12 @@ class RouterCore:
             help=f'forwarding latency to the {tier} tier')
         for tier in registry_lib.TIERS
     }
+    # Per-class end-to-end latency (the per-class SLO signal): one
+    # histogram per priority class, pre-created for the configured
+    # weights so /metricz exposes the classes before traffic arrives.
+    self._class_hists: Dict[str, Any] = {}  # guarded by: self._lock
+    for klass in sorted(self.balancer.class_weights):
+      self._class_hist(klass)
     self._draining = False  # dclint: lock-free (monotonic bool flip,
     # read per request; worst case one request admitted during drain
     # finishes normally before drain() returns)
@@ -124,6 +148,16 @@ class RouterCore:
 
   def bump(self, key: str, n: int = 1) -> None:
     self.obs.inc(key, n)
+
+  def _class_hist(self, klass: str):
+    with self._lock:
+      hist = self._class_hists.get(klass)
+      if hist is None:
+        hist = self.obs.histogram(
+            f'route_class_{klass}_latency_s',
+            help=f'end-to-end routed latency for priority class {klass}')
+        self._class_hists[klass] = hist
+      return hist
 
   # -- forwarding --------------------------------------------------------
 
@@ -161,7 +195,9 @@ class RouterCore:
       conn.close()
 
   def _forward_with_retry(self, tier: str, path: str, body: bytes,
-                          headers: Dict[str, str]
+                          headers: Dict[str, str],
+                          klass: Optional[str] = None,
+                          client: Optional[str] = None
                           ) -> Tuple[int, bytes, str]:
     """Places the request on the least-loaded replica of `tier`,
     moving to a different replica only when the previous one provably
@@ -171,7 +207,11 @@ class RouterCore:
     t0 = time.monotonic()
     for attempt in range(self.options.max_attempts):
       try:
-        replica = self.balancer.acquire(tier, exclude=tried)
+        replica = self.balancer.acquire(tier, exclude=tried,
+                                        klass=klass, client=client)
+      except shared_faults.QuotaExceededError:
+        self.bump('n_quota_rejected')
+        raise
       except shared_faults.FleetRejection:
         if last_reject is not None:
           # Every other replica is excluded/saturated; relay the
@@ -190,22 +230,25 @@ class RouterCore:
       except _SendPhaseError as e:
         log.warning('%s never acked (%s); retrying elsewhere',
                     replica.url, e)
-        self.balancer.release(replica.url, 'send_failure')
+        self.balancer.release(replica.url, 'send_failure',
+                              klass=klass, client=client)
         self.registry.mark_unreachable(replica.url)
         continue
       except shared_faults.ReplicaLostError:
-        self.balancer.release(replica.url, 'lost')
+        self.balancer.release(replica.url, 'lost',
+                              klass=klass, client=client)
         self.registry.mark_unreachable(replica.url)
         self.bump('n_replica_lost')
         raise
       if status in _RETRYABLE_UPSTREAM:
         draining = b'UNAVAILABLE' in data or b'draining' in data
-        self.balancer.release(replica.url, 'reject')
+        self.balancer.release(replica.url, 'reject',
+                              klass=klass, client=client)
         if draining:
           self.registry.mark_draining(replica.url)
         last_reject = _UpstreamRejected(status, data, draining)
         continue
-      self.balancer.release(replica.url, 'ok')
+      self.balancer.release(replica.url, 'ok', klass=klass, client=client)
       self._tier_hists[tier].observe(time.monotonic() - t0)
       return status, data, ctype
     if last_reject is not None:
@@ -222,47 +265,74 @@ class RouterCore:
 
   def route(self, body: bytes,
             deadline_header: Optional[str] = None,
-            trace_id: Optional[str] = None) -> Tuple[int, bytes, str]:
+            trace_id: Optional[str] = None,
+            klass: Optional[str] = None,
+            client: Optional[str] = None) -> Tuple[int, bytes, str]:
     """Routes one /v1/polish body; returns (status, body, ctype) to
     relay verbatim. Raises ServeRejection subtypes for router-level
     rejections (mapped to typed JSON by the HTTP layer).
 
     The router is the fleet's outermost tier, so it mints the trace id
     (unless the client sent one) and stamps it into the forwarded
-    headers — every downstream span joins this request's trace."""
+    headers — every downstream span joins this request's trace.
+
+    `klass`/`client` are the multi-tenant QoS attribution (protocol
+    CLASS_HEADER / CLIENT_HEADER): the class buys its weighted-fair
+    share of fleet capacity and its own latency histogram; the client
+    id is what per-client quotas are charged against."""
     if self._draining:
       raise shared_faults.DrainingError('router is draining')
     self.bump('n_requests')
+    klass = klass or self.options.default_class
+    if not _CLASS_RE.match(klass):
+      self.bump('n_bad_requests')
+      raise shared_faults.BadRequestError(
+          f'bad {protocol.CLASS_HEADER} value {klass!r}: '
+          'want [a-z0-9_-]{1,32}')
     trace_id = trace_id or obs_lib.trace.mint_trace_id()
     t_route = time.time()
+    t_mono = time.monotonic()
     frame = ''
     with self._lock:
       self._in_flight += 1
     try:
       frame = protocol.sniff_frame(body)
       headers = {'Content-Type': protocol.CONTENT_TYPE,
-                 protocol.TRACE_HEADER: trace_id}
+                 protocol.TRACE_HEADER: trace_id,
+                 protocol.CLASS_HEADER: klass}
+      if client:
+        headers[protocol.CLIENT_HEADER] = client
       if deadline_header:
         headers[protocol.DEADLINE_HEADER] = deadline_header
       if frame == protocol.FRAME_BAM:
         self.bump('n_routed_featurize')
         status, pack, ctype = self._forward_with_retry(
-            registry_lib.FEATURIZE_TIER, '/v1/featurize', body, headers)
+            registry_lib.FEATURIZE_TIER, '/v1/featurize', body, headers,
+            klass=klass, client=client)
         if status != 200:
           return status, pack, ctype  # worker's typed error, relayed
         body = pack
       self.bump('n_routed_model')
-      return self._forward_with_retry(
-          registry_lib.MODEL_TIER, '/v1/polish', body, headers)
+      status, data, ctype = self._forward_with_retry(
+          registry_lib.MODEL_TIER, '/v1/polish', body, headers,
+          klass=klass, client=client)
+      if status == 200:
+        self._class_hist(klass).observe(time.monotonic() - t_mono)
+      return status, data, ctype
     except shared_faults.BadRequestError:
       self.bump('n_bad_requests')
+      raise
+    except shared_faults.FleetRejection:
+      # Class-aware shed accounting (QuotaExceededError included):
+      # which class absorbed the rejection is the starvation signal.
+      self.bump(f'n_shed_{klass}')
       raise
     finally:
       with self._lock:
         self._in_flight -= 1
       obs_lib.trace.complete_event(
           'route', 'request', t_route, time.time(),
-          {'trace_id': trace_id, 'frame': frame})
+          {'trace_id': trace_id, 'frame': frame, 'class': klass})
 
   # -- lifecycle / views -------------------------------------------------
 
@@ -296,7 +366,7 @@ class RouterCore:
 
   def _latency_percentiles(self) -> Dict[str, Dict[str, Any]]:
     # Nearest-rank on the per-tier histograms (same fix as the serve
-    # replica's latency_percentiles; old keys alias for one release).
+    # replica's latency_percentiles).
     return {tier: h.percentiles() for tier, h in self._tier_hists.items()}
 
   def prom_text(self) -> str:
@@ -327,9 +397,10 @@ class RouterCore:
           'n_send_failures': r.n_send_failures,
           'n_lost': r.n_lost,
       })
+    with self._lock:
+      class_hists = dict(self._class_hists)
     return {
-        # Unified cross-tier schema (docs/observability.md); 'router'
-        # and 'in_flight' stay as legacy aliases of counters/outstanding.
+        # Unified cross-tier schema (docs/observability.md).
         'tier': 'router',
         'outstanding': in_flight,
         'draining': self._draining,
@@ -337,8 +408,11 @@ class RouterCore:
         'counters': counters,
         'histograms': registry_view['histograms'],
         'latency': self._latency_percentiles(),
-        'router': counters,
-        'in_flight': in_flight,
+        'class_latency': {
+            klass: h.percentiles()
+            for klass, h in sorted(class_hists.items())
+        },
+        'qos': self.balancer.qos_snapshot(),
         'replicas': replicas,
         'fleet_counters': self.registry.aggregate_counters(),
     }
@@ -432,7 +506,10 @@ def _make_handler(core: RouterCore):
           status, data, ctype = core.route(
               body,
               deadline_header=self.headers.get(protocol.DEADLINE_HEADER),
-              trace_id=self.headers.get(protocol.TRACE_HEADER) or None)
+              trace_id=self.headers.get(protocol.TRACE_HEADER) or None,
+              klass=self.headers.get(protocol.CLASS_HEADER) or None,
+              client=self.headers.get(protocol.CLIENT_HEADER)
+              or self.address_string())
         except shared_faults.ServeRejection as e:
           self._reply_error(e)
           return

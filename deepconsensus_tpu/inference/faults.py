@@ -53,6 +53,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ENV_KILL_ZMW,
     ENV_NAN_AT_STEP,
     ENV_POISON_WINDOW,
+    ENV_PREEMPT_AT_S,
     ENV_SERVE_CLIENT_FAULT,
     ENV_SERVE_CLIENT_FAULT_ZMW,
     ENV_SIGTERM_AT_STEP,
@@ -74,6 +75,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     FleetRejection,
     FlywheelGateError,
     NonFiniteTrainingError,
+    QuotaExceededError,
     ReplicaLostError,
     RequestTooLargeError,
     ServeRejection,
@@ -88,6 +90,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     maybe_kill_worker,
     maybe_poison_batch,
     maybe_sigterm_at_step,
+    preempt_notice_after_s,
     read_dead_letters,
 )
 

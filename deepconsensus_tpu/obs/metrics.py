@@ -189,11 +189,6 @@ class Histogram:
         'p50': None if p50 is None else round(p50, 4),
         'p99': None if p99 is None else round(p99, 4),
         'count': n,
-        # Aliases kept for one release: pre-obs /metricz consumers read
-        # the deque-era keys (docs/observability.md deprecation note).
-        'p50_s': None if p50 is None else round(p50, 4),
-        'p99_s': None if p99 is None else round(p99, 4),
-        'n': n,
     }
 
 

@@ -44,10 +44,16 @@ class ServeClient:
   """One connection-per-call HTTP client (stdlib http.client)."""
 
   def __init__(self, host: str = '127.0.0.1', port: int = 8764,
-               timeout: float = 180.0):
+               timeout: float = 180.0, klass: Optional[str] = None,
+               client: Optional[str] = None):
     self.host = host
     self.port = port
     self.timeout = timeout
+    # Multi-tenant QoS identity, sent as headers on every polish: the
+    # router charges admission to (class, client). Unset = the
+    # router's defaults (interactive class, peer-address client).
+    self.klass = klass
+    self.client = client
 
   def _request(self, method: str, path: str, body: bytes = b'',
                headers: Optional[Dict[str, str]] = None):
@@ -59,6 +65,19 @@ class ServeClient:
       return resp.status, resp.read(), resp.getheader('Content-Type', '')
     finally:
       conn.close()
+
+  def _polish_headers(self, deadline_s: Optional[float],
+                      trace_id: Optional[str]) -> Dict[str, str]:
+    headers = {'Content-Type': protocol.CONTENT_TYPE}
+    if deadline_s is not None:
+      headers[protocol.DEADLINE_HEADER] = str(deadline_s)
+    if trace_id:
+      headers[protocol.TRACE_HEADER] = trace_id
+    if self.klass:
+      headers[protocol.CLASS_HEADER] = self.klass
+    if self.client:
+      headers[protocol.CLIENT_HEADER] = self.client
+    return headers
 
   def _get_json(self, path: str) -> Dict[str, Any]:
     status, body, _ = self._request('GET', path)
@@ -103,13 +122,9 @@ class ServeClient:
     if sabotaged:
       return {'status': 'client-fault', 'mode': sabotaged,
               'seq': b'', 'quals': None}
-    headers = {'Content-Type': protocol.CONTENT_TYPE}
-    if deadline_s is not None:
-      headers[protocol.DEADLINE_HEADER] = str(deadline_s)
-    if trace_id:
-      headers[protocol.TRACE_HEADER] = trace_id
     status, resp_body, ctype = self._request(
-        'POST', '/v1/polish', body=body, headers=headers)
+        'POST', '/v1/polish', body=body,
+        headers=self._polish_headers(deadline_s, trace_id))
     if status != 200:
       try:
         payload = json.loads(resp_body)
@@ -148,13 +163,9 @@ class ServeClient:
     if sabotaged:
       return {'status': 'client-fault', 'mode': sabotaged,
               'seq': b'', 'quals': None}
-    headers = {'Content-Type': protocol.CONTENT_TYPE}
-    if deadline_s is not None:
-      headers[protocol.DEADLINE_HEADER] = str(deadline_s)
-    if trace_id:
-      headers[protocol.TRACE_HEADER] = trace_id
     status, resp_body, _ = self._request(
-        'POST', '/v1/polish', body=body, headers=headers)
+        'POST', '/v1/polish', body=body,
+        headers=self._polish_headers(deadline_s, trace_id))
     if status != 200:
       try:
         payload = json.loads(resp_body)

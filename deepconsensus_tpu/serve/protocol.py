@@ -69,6 +69,15 @@ DEADLINE_HEADER = 'X-Dctpu-Deadline-S'
 # traffic) and carried across every hop so spans from router,
 # featurize worker and replica join into one trace (obs.trace).
 TRACE_HEADER = 'X-Dctpu-Trace-Id'
+# Multi-tenant QoS (fleet tier). CLASS_HEADER names the priority class
+# the request is admitted under ('interactive', 'bulk', ...; lowercase
+# [a-z0-9_-], ≤32 chars — anything else is a typed 400). CLIENT_HEADER
+# is the tenant id per-client quotas are charged against; absent, the
+# router falls back to the peer address. Both are advisory to a bare
+# replica (it serves FIFO) — the router is where weighted-fair
+# admission happens.
+CLASS_HEADER = 'X-Dctpu-Class'
+CLIENT_HEADER = 'X-Dctpu-Client'
 REQUEST_FIELDS = ('name', 'subreads', 'window_pos', 'ccs_bq', 'overflow')
 _META_KEYS = ('ec', 'np_num_passes', 'rq', 'rg')
 

@@ -244,6 +244,55 @@ def build_server(service: ConsensusService, host: str,
   return ServeHTTPServer((host, port), _make_handler(service))
 
 
+class _PreemptionWatch:
+  """Preemption notice: an early warning that this replica is about to
+  be killed (cloud preemption, spot reclaim, scale-in). Two delivery
+  paths set the same flag:
+
+    * SIGUSR1 — the external notice (inject_faults.py preempt, or a
+      node-agent relaying the provider's preemption warning).
+    * DCTPU_FAULT_PREEMPT_AT_S — the env fault hook: a daemon timer
+      self-delivers the notice N seconds after serve start, so tests
+      and soaks exercise the path without process signals.
+
+  Like _StopFlag, the handler only sets a flag; serve_main's main
+  thread sees it and runs the normal drain — /readyz flips to 503
+  draining (the router stops routing here), admitted work finishes,
+  and the process exits 0 well before the provider's hard kill."""
+
+  def __init__(self):
+    self.noticed = threading.Event()
+    self._saved = None
+    self._timer: Optional[threading.Timer] = None
+
+  def install(self):
+    try:
+      self._saved = signal.signal(signal.SIGUSR1, self._handle)
+    except ValueError:
+      # Not the main thread (in-process tests): the env timer below
+      # still works, and tests can call notice() directly.
+      pass
+    at_s = shared_faults.preempt_notice_after_s()
+    if at_s > 0:
+      self._timer = threading.Timer(at_s, self.notice)
+      self._timer.daemon = True
+      self._timer.start()
+
+  def notice(self) -> None:
+    log.warning('preemption notice: draining ahead of the kill')
+    self.noticed.set()
+
+  def restore(self):
+    if self._timer is not None:
+      self._timer.cancel()
+    if self._saved is not None:
+      signal.signal(signal.SIGUSR1, self._saved)
+
+  def _handle(self, signum, frame):
+    del signum, frame
+    self.notice()
+
+
 class _StopFlag:
   """PreemptionGuard-style: the signal handler only sets a flag (and
   remembers which signal); the main thread owns the drain."""
@@ -301,6 +350,8 @@ def serve_main(runner, options, serve_options: ServeOptions,
   http_thread.start()
   stop = _StopFlag()
   stop.install()
+  preempt = _PreemptionWatch()
+  preempt.install()
   info = {
       'event': 'ready',
       'host': host,
@@ -315,21 +366,27 @@ def serve_main(runner, options, serve_options: ServeOptions,
     while not stop.event.wait(timeout=0.5):
       if stop_event is not None and stop_event.is_set():
         break
+      if preempt.noticed.is_set():
+        break
       if not service.healthy:
         log.error('model loop died; shutting down')
         break
     if stop.signum is not None:
       log.warning('signal %d: draining (no new admissions)', stop.signum)
     # Drain while the listener stays up: in-flight handler threads can
-    # still deliver their responses; new polish requests get 503.
+    # still deliver their responses; new polish requests get 503. A
+    # preemption notice takes the same path — the only difference is
+    # who asked (provider warning vs operator SIGTERM).
     service.begin_drain()
     drained = service.drain(timeout=serve_options.max_deadline_s + 30)
     if not drained:
       log.error('drain timed out with work outstanding')
   finally:
     stop.restore()
+    preempt.restore()
     httpd.shutdown()
     httpd.server_close()
   stats = service.stats()
   stats['drained'] = bool(drained)
+  stats['preempted'] = preempt.noticed.is_set()
   return stats

@@ -587,20 +587,22 @@ class ConsensusService:
 
     The deque-era index math (lat[int(n * 0.99)]) under-reported p99
     at small n; the histogram percentile is the textbook nearest-rank
-    definition, quantized to bucket edges. The old p50_s/p99_s/n keys
-    ride along as aliases for one release."""
+    definition, quantized to bucket edges."""
     return self._latency_hist.percentiles()
 
   def prom_text(self) -> str:
     """/metricz?format=prom payload: the registry's typed exposition
-    plus the pre-registry faults counters as untyped samples."""
+    plus the pre-registry quarantine counters as untyped samples (the
+    registry-owned names are excluded so no sample appears twice)."""
+    registry_keys = set(self.metrics.snapshot()['counters'])
+    extra = {k: v for k, v in self.stats()['counters'].items()
+             if k not in registry_keys}
     return (self.metrics.to_prom('serve')
-            + obs_lib.metrics.prom_counters_text(
-                self.stats()['faults'], tier='serve'))
+            + obs_lib.metrics.prom_counters_text(extra, tier='serve'))
 
   def stats(self) -> Dict[str, Any]:
-    """The faults metrics split: per-request serve counters next to the
-    quarantine counters the batch pipeline already reports."""
+    """The unified /metricz split: per-request serve counters next to
+    the quarantine counters the batch pipeline already reports."""
     counters = dict(self.quarantine.counters)
     counters.setdefault('n_requests', 0)
     counters.setdefault('n_rejected_backpressure', 0)
@@ -653,7 +655,6 @@ class ConsensusService:
         'counters': {**registry_view['counters'], **counters},
         'histograms': registry_view['histograms'],
         'capacity': self.capacity(),
-        'faults': counters,
         'latency': self.latency_percentiles(),
         'outcomes': dataclasses.asdict(self.outcome),
     }

@@ -27,7 +27,10 @@
 #   ./run_all_tests.sh fleet       # fleet tier only: `dctpu route`
 #                                  # balancing/retry semantics,
 #                                  # featurize workers, protocol
-#                                  # version negotiation
+#                                  # version negotiation, probe
+#                                  # hysteresis, weighted-fair QoS +
+#                                  # quotas, preemption notice drain,
+#                                  # autoscaler control law
 #   ./run_all_tests.sh epilogue    # device-resident output plane only:
 #                                  # threshold-table exactness + FASTQ
 #                                  # byte-identity across levers/dp/

@@ -39,6 +39,13 @@ Serve-side tools (`dctpu serve` robustness drills):
              byte per interval). The daemon must shed each with a
              typed rejection while concurrent well-formed clients
              keep completing.
+* preempt  — cloud-preemption drill against a running replica pid:
+             deliver the preemption notice (SIGUSR1 — the replica
+             flips to draining and finishes admitted work), then
+             SIGKILL after the provider's grace deadline if it is
+             still alive. A drain-clean replica exits 0 before the
+             kill lands; with `dctpu autoscale` watching the fleet,
+             capacity is replaced while the victim drains.
 
 Worker SIGKILL, NaN-batch, preemption-signal, consumer-crash, poison
 window, and client self-sabotage injection are driven by env vars read
@@ -48,8 +55,10 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import sys
-from typing import List, Optional, Sequence, Tuple
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -397,6 +406,38 @@ def corrupt_checkpoint(ckpt_path: str, mode: str = 'truncate',
   return largest
 
 
+def preempt_replica(pid: int, grace_s: float = 30.0,
+                    poll_interval_s: float = 0.2,
+                    is_alive=None) -> Dict[str, Any]:
+  """Cloud-preemption drill: SIGUSR1 notice now, SIGKILL after the
+  grace deadline if the process is still alive. A well-behaved replica
+  (serve/server.py _PreemptionWatch) drains and exits inside the
+  grace window, so the kill never fires. `is_alive` defaults to an
+  os.kill(pid, 0) liveness probe; a caller that owns the Popen should
+  pass `lambda: proc.poll() is None` so zombies count as exited."""
+  if is_alive is None:
+    def is_alive():
+      try:
+        os.kill(pid, 0)
+        return True
+      except ProcessLookupError:
+        return False
+  t0 = time.monotonic()
+  os.kill(pid, signal.SIGUSR1)
+  while time.monotonic() - t0 < grace_s:
+    if not is_alive():
+      return {'pid': pid, 'noticed': True, 'killed': False,
+              'waited_s': round(time.monotonic() - t0, 3)}
+    time.sleep(poll_interval_s)
+  killed = True
+  try:
+    os.kill(pid, signal.SIGKILL)
+  except ProcessLookupError:
+    killed = False  # exited right at the deadline
+  return {'pid': pid, 'noticed': True, 'killed': killed,
+          'waited_s': round(time.monotonic() - t0, 3)}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
   parser = argparse.ArgumentParser(
       description=__doc__,
@@ -442,6 +483,11 @@ def main(argv: Optional[List[str]] = None) -> int:
           'finalize so the --dispatch_timeout watchdog must fire\n'
           '  DCTPU_FAULT_DEVICE_HANG_S=<secs>  hang duration for '
           'HANG_AT_PACK (default 30)\n'
+          '  DCTPU_FAULT_PREEMPT_AT_S=<secs>   `dctpu serve`: the '
+          'replica delivers itself a preemption notice N seconds '
+          'after start — /readyz flips to 503 draining, admitted work '
+          'finishes, clean exit with preempted=true (same path as an '
+          'external SIGUSR1 / `preempt` below)\n'
       ),
   )
   sub = parser.add_subparsers(dest='command', required=True)
@@ -520,6 +566,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                  help='Command to exec with the hook armed; without '
                  'one, print the env assignments to eval.')
 
+  p = sub.add_parser('preempt',
+                     help='Preemption notice (SIGUSR1) to a replica '
+                     'pid, then SIGKILL after the grace deadline if '
+                     'it is still alive.')
+  p.add_argument('--pid', type=int, required=True)
+  p.add_argument('--grace_s', type=float, default=30.0,
+                 help='Provider grace window between notice and hard '
+                 'kill.')
+
   p = sub.add_parser('serve_client',
                      help='Adversarial client against a running '
                      '`dctpu serve` daemon.')
@@ -597,6 +652,13 @@ def main(argv: Optional[List[str]] = None) -> int:
       return 0
     os.environ.update(env)
     os.execvp(cmd[0], cmd)
+
+  if args.command == 'preempt':
+    import json
+
+    result = preempt_replica(args.pid, grace_s=args.grace_s)
+    print(json.dumps(result))
+    return 0 if not result['killed'] else 1
 
   if args.command == 'serve_client':
     from deepconsensus_tpu.serve import client as client_lib

@@ -29,11 +29,16 @@
 #                                         # route` balancing + retry
 #                                         # semantics, featurize
 #                                         # workers, protocol version
-#                                         # negotiation (the multi-
-#                                         # replica rolling-restart
-#                                         # acceptance demo is
+#                                         # negotiation, probe
+#                                         # hysteresis, weighted-fair
+#                                         # QoS + quota sheds, the
+#                                         # preemption notice drain,
+#                                         # autoscaler scale-out/in/
+#                                         # replace drills (the real-
+#                                         # subprocess autoscale +
+#                                         # forced-preemption demo is
 #                                         # scripts/soak_e2e.py
-#                                         # --fleet 3)
+#                                         # --fleet 2)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -74,8 +79,10 @@ fi
 
 if [[ "${1:-}" == "--fleet" ]]; then
   shift
-  # The fleet tier in isolation: router + registry + balancer +
-  # featurize-worker semantics, all in-process (fast).
+  # The fleet tier in isolation: router + registry (incl. probe
+  # hysteresis) + balancer (weighted-fair admission, quotas) +
+  # featurize-worker + autoscaler + preemption semantics, all
+  # in-process (fast).
   exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_fleet.py \
     -q --continue-on-collection-errors "$@"

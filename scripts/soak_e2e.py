@@ -213,13 +213,23 @@ def _drained_line(proc):
 
 
 def fleet_soak(args) -> int:
-  """N serve replicas behind `dctpu route`, with a rolling restart
-  mid-soak and a disaggregated bam/1 leg."""
+  """N serve replicas behind `dctpu route` with a `dctpu autoscale`
+  controller holding the interactive-class SLO: the load ramp forces a
+  scale-out, a forced preemption (SIGUSR1 notice + kill deadline) of
+  an operator replica is absorbed by a drain + autoscaler replacement,
+  and a disaggregated bam/1 leg rides the featurize tier. Workers are
+  class-labeled (one interactive, the rest bulk) so the router's
+  per-class latency histograms carry the SLO evidence."""
   sys.path.insert(0, os.path.dirname(os.path.dirname(
       os.path.abspath(__file__))))
   from deepconsensus_tpu.serve.client import ServeClient, ServeClientError
+  from scripts.inject_faults import preempt_replica
   from scripts.inject_faults import write_synthetic_zmw_bams
 
+  if args.fleet < 2:
+    print('fleet soak needs --fleet >= 2 (one replica is preempted '
+          'mid-run)', flush=True)
+    return 1
   t0 = time.time()
   molecules, _synth_dir = _featurize_synth(args, args.serve_zmws)
   print(f'featurized {len(molecules)} molecules', flush=True)
@@ -261,6 +271,7 @@ def fleet_soak(args) -> int:
   print(json.dumps(worker_ready), flush=True)
 
   router_cmd = ['route', '--port', '0', '--probe_interval_s', '0.2',
+                '--queue_wait_s', '0.3',
                 '--featurize_worker',
                 f'127.0.0.1:{worker_ready["port"]}']
   for _, port in replicas:
@@ -272,6 +283,36 @@ def fleet_soak(args) -> int:
   if not router_client.wait_ready(120):
     print('router never became ready', flush=True)
     return 1
+
+  # The SLO autoscaler: min = the operator fleet, max allows exactly
+  # one scale-out. The p99 target is deliberately tight so the load
+  # ramp provably crosses it; the scale-in cooldown is effectively
+  # infinite so the replica count only moves for reasons this soak
+  # asserts on (scale-out, preemption replacement). Spawned replicas
+  # carry the same flags as the operator ones (deterministic
+  # random-init weights + the shared compile cache), so byte identity
+  # holds no matter who serves a request.
+  scaler_cmd = ['autoscale', '--router', f'127.0.0.1:{router_port}',
+                '--tier', 'model',
+                '--min_replicas', str(args.fleet),
+                '--max_replicas', str(args.fleet + 1),
+                '--target_p99_s', str(args.autoscale_p99_s),
+                '--target_queue_depth', '1e9',
+                '--slo_class', 'interactive',
+                '--poll_interval_s', '0.5',
+                '--scale_out_cooldown_s', '2',
+                '--scale_in_cooldown_s', '100000',
+                '--serve_arg=--random_init',
+                '--serve_arg=--config',
+                '--serve_arg=transformer_learn_values+test',
+                '--serve_arg=--min_quality',
+                '--serve_arg=0',
+                '--serve_arg=--batch_size',
+                f'--serve_arg={args.serve_batch_size}',
+                '--serve_arg=--compilation_cache_dir',
+                f'--serve_arg={cache_dir}']
+  scaler_proc, scaler_ready = _spawn(scaler_cmd, env)
+  print(json.dumps(scaler_ready), flush=True)
 
   # Solo baseline: one pass straight at replica 0 — the bytes every
   # routed result must reproduce exactly.
@@ -295,7 +336,12 @@ def fleet_soak(args) -> int:
   stop_workers = threading.Event()
 
   def worker(wid):
-    client = ServeClient(port=router_port, timeout=300)
+    # Multi-tenant attribution: worker 0 is the interactive tenant the
+    # SLO is asserted for; the rest are bulk backfill.
+    client = ServeClient(
+        port=router_port, timeout=300,
+        klass='interactive' if wid == 0 else 'bulk',
+        client=f'worker-{wid}')
     start = wid % max(1, len(molecules))
     rotated = molecules[start:] + molecules[:start]
     for _ in range(args.serve_rounds):
@@ -348,28 +394,64 @@ def fleet_soak(args) -> int:
   for t in threads:
     t.start()
 
-  # Rolling restart mid-soak: SIGTERM replica 0, wait for its clean
-  # drain, respawn with the shared compile cache, register the new
-  # replica with the running router.
+  def model_tier_counts():
+    try:
+      m = router_client.metricz()
+    except (OSError, ValueError):
+      return 0, 0
+    reps = [r for r in m.get('replicas', []) if r.get('tier') == 'model']
+    ready = sum(1 for r in reps if r.get('state') == 'ready')
+    live = sum(1 for r in reps
+               if r.get('state') in ('ready', 'joining'))
+    return ready, live
+
+  # Phase 1 — SLO scale-out: under the client ramp the cumulative
+  # interactive p99 crosses the (deliberately tight) autoscale target
+  # and the controller grows the model tier by one replica.
   time.sleep(2.0)
-  old_proc, old_port = replicas[0]
-  old_proc.send_signal(signal.SIGTERM)
-  roll_rc = old_proc.wait(timeout=300)
-  roll_drained = bool(_drained_line(old_proc).get('drained'))
-  new_proc, new_ready = spawn_replica()
-  replicas[0] = [new_proc, new_ready['port']]
-  status, body, _ = router_client._request(
-      'POST', '/v1/register',
-      body=json.dumps({'url': f'127.0.0.1:{new_ready["port"]}',
-                       'tier': 'model'}).encode())
-  rolled = {
-      'old_port': old_port, 'old_rc': roll_rc,
-      'old_drained': roll_drained,
-      'new_port': new_ready['port'],
-      'register_status': status,
-      'register_body': json.loads(body),
+  max_ready = args.fleet
+  scaled_out = False
+  scale_deadline = time.monotonic() + 300
+  while time.monotonic() < scale_deadline:
+    ready_n, _live_n = model_tier_counts()
+    max_ready = max(max_ready, ready_n)
+    if ready_n >= args.fleet + 1:
+      scaled_out = True
+      break
+    time.sleep(0.5)
+
+  # Phase 2 — forced preemption of an operator replica: the SIGUSR1
+  # notice flips it to draining (the router routes nothing new to it),
+  # it finishes admitted work and exits 0 with preempted=true well
+  # inside the grace window (the hard kill never fires), and the
+  # autoscaler restores the lost capacity without any manual respawn
+  # or re-register.
+  old_proc, old_port = replicas.pop(0)
+  drill = preempt_replica(
+      old_proc.pid, grace_s=300,
+      is_alive=lambda: old_proc.poll() is None)
+  old_rc = old_proc.wait(timeout=300)
+  old_info = _drained_line(old_proc)
+  want_live = args.fleet + 1 if scaled_out else args.fleet
+  replaced = False
+  replace_deadline = time.monotonic() + 300
+  while time.monotonic() < replace_deadline:
+    _ready_n, live_n = model_tier_counts()
+    if live_n >= want_live:
+      replaced = True
+      break
+    time.sleep(0.5)
+  preempted = {
+      'old_port': old_port, 'old_rc': old_rc,
+      'old_drained': bool(old_info.get('drained')),
+      'old_preempted': bool(old_info.get('preempted')),
+      'kill_fired': bool(drill['killed']),
+      'notice_to_exit_s': drill['waited_s'],
+      'scaled_out': scaled_out,
+      'max_ready_observed': max_ready,
+      'replaced': replaced,
   }
-  print(json.dumps({'event': 'rolled', **rolled}), flush=True)
+  print(json.dumps({'event': 'preempted', **preempted}), flush=True)
 
   for t in threads:
     t.join()
@@ -421,7 +503,12 @@ def fleet_soak(args) -> int:
 
   metricz = router_client.metricz()
 
-  # Drain the fleet: router first (stops admissions), then tiers.
+  # Drain the fleet: the autoscaler first (it SIGTERM-drains every
+  # replica it spawned), then the router (stops admissions), then the
+  # remaining operator tiers.
+  scaler_proc.send_signal(signal.SIGTERM)
+  scaler_rc = scaler_proc.wait(timeout=600)
+  scaler_info = _drained_line(scaler_proc)
   router_proc.send_signal(signal.SIGTERM)
   router_rc = router_proc.wait(timeout=300)
   router_drained = bool(_drained_line(router_proc).get('drained'))
@@ -469,12 +556,19 @@ def fleet_soak(args) -> int:
       'n_shed_retries': n_shed_retries[0],
       'n_client_errors': len(errors),
       'bam_leg': {'ok': bam_ok, 'mismatched': bam_mismatch},
-      'rolled': rolled,
+      'preempted': preempted,
+      'autoscale': {
+          'rc': scaler_rc,
+          'counters': scaler_info.get('counters', {}),
+          'managed': scaler_info.get('managed', []),
+      },
       'p50_s': round(lat[len(lat) // 2], 4) if lat else None,
       'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4)
                if lat else None,
-      'router_counters': metricz.get('router', {}),
+      'router_counters': metricz.get('counters', {}),
       'router_latency': metricz.get('latency', {}),
+      'class_latency': metricz.get('class_latency', {}),
+      'qos': metricz.get('qos', {}),
       'router_rc': router_rc,
       'router_drained': router_drained,
       'tier_rcs': tier_rcs,
@@ -495,9 +589,28 @@ def fleet_soak(args) -> int:
           flush=True)
   if accepted_then_lost:
     print(f'ACCEPTED-THEN-LOST: {accepted_then_lost[:10]}', flush=True)
+  scaler_counters = scaler_info.get('counters', {})
+  interactive_p99 = metricz.get('class_latency', {}).get(
+      'interactive', {}).get('p99')
   ok = (not mismatches and not accepted_then_lost and not errors
-        and n_ok[0] > 0 and rolled['old_rc'] == 0
-        and rolled['old_drained'] and rolled['register_status'] == 200
+        and n_ok[0] > 0
+        # Preemption drill: clean notice-driven drain, kill never
+        # fired, the autoscaler replaced the capacity.
+        and preempted['old_rc'] == 0 and preempted['old_drained']
+        and preempted['old_preempted'] and not preempted['kill_fired']
+        and preempted['replaced']
+        # Replica count provably moved: the ramp forced a scale-out
+        # and the controller both scaled out and replaced at least
+        # once by its own accounting.
+        and preempted['scaled_out']
+        and preempted['max_ready_observed'] >= args.fleet + 1
+        and scaler_rc == 0
+        and scaler_counters.get('n_scale_out', 0) >= 1
+        and scaler_counters.get('n_replaced', 0) >= 1
+        # The interactive-class SLO held, as reported by the router's
+        # unified /metricz per-class histogram.
+        and interactive_p99 is not None
+        and interactive_p99 <= args.slo_p99_s
         and router_rc == 0 and router_drained
         and all(rc == 0 for rc in tier_rcs)
         and bam_mismatch == 0 and bam_ok > 0
@@ -617,7 +730,7 @@ def serve_soak(args) -> int:
       'p50_s': round(lat[len(lat) // 2], 4) if lat else None,
       'p99_s': round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4)
                if lat else None,
-      'daemon_faults': metricz.get('faults', {}),
+      'daemon_counters': metricz.get('counters', {}),
       'drained': bool(drained_line.get('drained')),
       'wall_s': round(time.time() - t0, 1),
   }
@@ -645,11 +758,24 @@ def main():
                   '1-core CPU host -> 4000 gives a >10 min soak)')
   ap.add_argument('--fleet', type=int, default=0, metavar='N',
                   help='Fleet mode: N serve replicas behind `dctpu '
-                  'route` (real subprocesses, shared compile cache), '
-                  'rolling restart mid-soak, disaggregated bam/1 leg.')
+                  'route` with a `dctpu autoscale` controller (real '
+                  'subprocesses, shared compile cache), forced '
+                  'preemption + replacement mid-soak, disaggregated '
+                  'bam/1 leg. Needs N >= 2.')
   ap.add_argument('--fleet_clients', type=int, default=4,
                   help='Fleet mode: concurrent clients through the '
-                  'router.')
+                  'router (client 0 is the interactive tenant, the '
+                  'rest are bulk).')
+  ap.add_argument('--autoscale_p99_s', type=float, default=0.05,
+                  help='Fleet mode: the autoscaler\'s interactive-p99 '
+                  'scale-out target — deliberately tight so the load '
+                  'ramp provably crosses it.')
+  ap.add_argument('--slo_p99_s', type=float, default=120.0,
+                  help='Fleet mode: the verdict gate on the '
+                  'interactive-class p99 reported by the router '
+                  '(generous: CPU hosts serve slowly; the gate is '
+                  'that the class histogram exists and stays sane '
+                  'while the replica count moves).')
   ap.add_argument('--serve', type=int, default=0, metavar='N',
                   help='Serve mode: soak one `dctpu serve` daemon with '
                   'N concurrent clients instead of the batch pipeline.')

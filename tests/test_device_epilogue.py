@@ -243,7 +243,7 @@ def test_serve_stats_surface_epilogue_counters():
   runner_lib._apply_quant_levers(p, options)
   runner = runner_lib.ModelRunner(p, variables, options)
   service = ConsensusService(runner, options, ServeOptions())
-  faults = service.stats()['faults']
+  faults = service.stats()['counters']
   assert faults['device_epilogue'] == 1
   assert faults['n_epilogue_packs'] == 0
   assert faults['d2h_bytes_per_pack'] == 0

@@ -468,7 +468,7 @@ def test_serve_degrades_mid_stream_byte_identical(params, variables, arm):
   assert ready['degraded'] is True
   assert ready['mesh_dp'] == 4
   assert ready['initial_dp'] == 8
-  faults = metrics['faults']
+  faults = metrics['counters']
   assert faults['n_device_faults'] == 1
   assert faults['n_mesh_degradations'] == 1
   assert metrics['capacity']['degraded'] is True
@@ -488,8 +488,8 @@ def test_serve_oom_bisection_in_metricz(params, variables, arm):
     assert chaos['seq'] == clean['seq']
     np.testing.assert_array_equal(chaos['quals'], clean['quals'])
     m = client.metricz()
-    assert m['faults']['n_oom_bisections'] == 1
-    assert m['faults']['n_device_faults'] == 1
+    assert m['counters']['n_oom_bisections'] == 1
+    assert m['counters']['n_device_faults'] == 1
     ready = client.readyz()
     assert ready['degraded'] is False  # bisection is not degradation
 
@@ -527,5 +527,5 @@ def test_serve_drain_resolves_device_fault_on_final_pack(params,
   assert result['status'] == 'ok'
   assert service._loop_error is None
   stats = service.stats()
-  assert stats['faults']['n_device_faults'] == 1
-  assert stats['faults']['n_isolation_retries'] >= 1
+  assert stats['counters']['n_device_faults'] == 1
+  assert stats['counters']['n_isolation_retries'] >= 1

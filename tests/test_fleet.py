@@ -14,9 +14,18 @@ balancing/retry/drain semantics run in milliseconds:
   * multi-replica byte identity vs a solo replica, and the
     disaggregated bam/1 -> featurize worker -> model replica path vs
     monolithic client-side featurize;
-  * runtime /v1/register joins and the rolling-restart drain flow.
+  * runtime /v1/register joins and the rolling-restart drain flow;
+  * probe hysteresis: a flapping replica never re-enters the candidate
+    set until it earns ready_after consecutive healthy probes;
+  * multi-tenant QoS: weighted-fair admission (a saturating bulk
+    stream cannot starve an interactive trickle), per-client quotas
+    as typed 429s, class-aware shed accounting;
+  * the preemption notice -> drain -> exit path on the replica, and
+    the autoscaler control law (scale out on SLO breach, scale in
+    cold, replace preempted capacity) against scripted signals.
 
-The real-subprocess rolling-restart acceptance demo lives in
+The real-subprocess acceptance demo — autoscaler holding the SLO
+through a load ramp plus a forced preemption drill — lives in
 scripts/soak_e2e.py --fleet (scripts/run_resilience.sh --fleet).
 """
 import json
@@ -31,6 +40,7 @@ import pytest
 from deepconsensus_tpu import faults as shared_faults
 from deepconsensus_tpu.fleet import registry as registry_lib
 from deepconsensus_tpu.fleet import router as router_lib
+from deepconsensus_tpu.fleet.autoscaler import Autoscaler, AutoscalerOptions
 from deepconsensus_tpu.fleet.balancer import LeastLoadedBalancer
 from deepconsensus_tpu.fleet.featurize_worker import (
     FeaturizeService,
@@ -403,6 +413,176 @@ def test_registry_aggregates_replica_counters():
   assert agg['x_fraction'] == pytest.approx(0.75)  # fractions average
 
 
+def test_flapping_replica_needs_consecutive_healthy_probes(monkeypatch):
+  """Probe hysteresis regression: a replica flapping alive/dead never
+  re-enters the balancer's candidate set on a single good probe — READY
+  after DEAD requires ready_after CONSECUTIVE healthy probes, and an
+  explicit re-register (operator intent) clears the debt."""
+  script = ['ok']
+
+  class FakeProbeClient:
+    def __init__(self, host=None, port=None, timeout=None):
+      del host, port, timeout
+
+    def readyz(self):
+      if script[0] == 'down':
+        raise OSError('connection refused')
+      return {'ready': True, 'mesh_dp': 1}
+
+    def metricz(self):
+      return {'outstanding': 0, 'counters': {}}
+
+  monkeypatch.setattr(registry_lib, 'ServeClient', FakeProbeClient)
+  reg = ReplicaRegistry(dead_after=1, ready_after=2)
+  reg.add('127.0.0.1:9', tier=MODEL_TIER)
+  balancer = LeastLoadedBalancer(reg)
+
+  def probe(outcome):
+    script[0] = outcome
+    reg.probe_all()
+    return reg.snapshot()[0].state
+
+  # A fresh join has no hysteresis debt: one healthy probe suffices.
+  assert probe('ok') == ReplicaState.READY
+  assert probe('down') == ReplicaState.DEAD
+  # One good probe mid-flap is noise: health-gated, no traffic.
+  assert probe('ok') == ReplicaState.JOINING
+  with pytest.raises(shared_faults.FleetRejection):
+    balancer.acquire(MODEL_TIER)
+  # The next miss resets the streak; healing starts over.
+  assert probe('down') == ReplicaState.DEAD
+  assert probe('ok') == ReplicaState.JOINING
+  # The second CONSECUTIVE healthy probe earns READY back.
+  assert probe('ok') == ReplicaState.READY
+  assert balancer.acquire(MODEL_TIER).url == '127.0.0.1:9'
+  balancer.release('127.0.0.1:9', 'ok')
+  # Explicit re-registration (rolling-restart rejoin) clears the debt:
+  # one healthy probe promotes again.
+  assert probe('down') == ReplicaState.DEAD
+  reg.add('127.0.0.1:9', tier=MODEL_TIER)
+  assert probe('ok') == ReplicaState.READY
+
+
+# ----------------------------------------------------------------------
+# Multi-tenant QoS: weighted-fair admission, quotas, class shed
+
+
+def test_wfq_interactive_trickle_beats_queued_bulk_backlog():
+  """Starvation regression: with the only slot held and a bulk backlog
+  already queued, a later-arriving interactive waiter (weight 4) gets
+  the first freed slot — its virtual finish time lands ahead of the
+  weight-1 backlog."""
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1')
+  bal = LeastLoadedBalancer(reg, max_inflight=1, queue_wait_s=20.0,
+                            max_queued_per_class=8)
+  bal.acquire(MODEL_TIER, klass='bulk', client='hog')  # hold the slot
+  order = []
+  threads = []
+
+  def waiter(klass, tag):
+    replica = bal.acquire(MODEL_TIER, klass=klass)
+    order.append(tag)
+    bal.release(replica.url, 'ok', klass=klass)
+
+  def queued():
+    return bal.qos_snapshot()['queued'].get(MODEL_TIER, 0)
+
+  for i in range(3):
+    t = threading.Thread(target=waiter, args=('bulk', f'bulk{i}'))
+    t.start()
+    threads.append(t)
+    deadline = time.monotonic() + 10
+    while queued() < i + 1 and time.monotonic() < deadline:
+      time.sleep(0.005)
+  assert queued() == 3
+  t = threading.Thread(target=waiter, args=('interactive', 'int0'))
+  t.start()
+  threads.append(t)
+  deadline = time.monotonic() + 10
+  while queued() < 4 and time.monotonic() < deadline:
+    time.sleep(0.005)
+  # Free the slot: the interactive waiter must be served first even
+  # though three bulk waiters queued before it.
+  bal.release('a:1', 'ok', klass='bulk', client='hog')
+  for t in threads:
+    t.join(timeout=15)
+  assert order[0] == 'int0'
+  assert sorted(order[1:]) == ['bulk0', 'bulk1', 'bulk2']
+  qos = bal.qos_snapshot()
+  assert qos['queued'] == {}
+  assert qos['class_in_flight'] == {}
+
+
+def test_bulk_overflow_sheds_only_bulk_and_names_the_class():
+  """Per-class queue bound: the class that overflows its own admission
+  queue is the class that sheds — interactive still queues and places."""
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1')
+  bal = LeastLoadedBalancer(reg, max_inflight=1, queue_wait_s=20.0,
+                            max_queued_per_class=2)
+  bal.acquire(MODEL_TIER, klass='bulk')  # hold the slot
+  threads = []
+
+  def waiter(klass):
+    replica = bal.acquire(MODEL_TIER, klass=klass)
+    bal.release(replica.url, 'ok', klass=klass)
+
+  for _ in range(2):  # fill bulk's queue to its bound
+    t = threading.Thread(target=waiter, args=('bulk',))
+    t.start()
+    threads.append(t)
+  deadline = time.monotonic() + 10
+  while (bal.qos_snapshot()['queued'].get(MODEL_TIER, 0) < 2
+         and time.monotonic() < deadline):
+    time.sleep(0.005)
+  with pytest.raises(shared_faults.FleetRejection,
+                     match="class 'bulk' admission queue is full"):
+    bal.acquire(MODEL_TIER, klass='bulk')
+  # Interactive is unaffected by bulk's overflow: it queues and places.
+  t = threading.Thread(target=waiter, args=('interactive',))
+  t.start()
+  threads.append(t)
+  bal.release('a:1', 'ok', klass='bulk')
+  for t in threads:
+    t.join(timeout=15)
+  assert not any(t.is_alive() for t in threads)
+
+
+def test_saturated_wait_sheds_with_typed_503_at_deadline():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1')
+  bal = LeastLoadedBalancer(reg, max_inflight=1, queue_wait_s=0.2)
+  bal.acquire(MODEL_TIER)
+  t0 = time.monotonic()
+  with pytest.raises(shared_faults.FleetRejection,
+                     match='weighted-fair wait') as e:
+    bal.acquire(MODEL_TIER, klass='bulk')
+  assert time.monotonic() - t0 >= 0.15
+  assert e.value.http_status == 503
+  assert e.value.kind == shared_faults.FaultKind.TRANSIENT
+
+
+def test_client_quota_is_typed_429_charged_to_that_tenant_alone():
+  reg = ReplicaRegistry()
+  _ready_replica(reg, 'a:1')
+  bal = LeastLoadedBalancer(reg, client_quota=2)
+  bal.acquire(MODEL_TIER, client='tenant-a')
+  bal.acquire(MODEL_TIER, client='tenant-a')
+  with pytest.raises(shared_faults.QuotaExceededError) as e:
+    bal.acquire(MODEL_TIER, client='tenant-a')
+  assert e.value.http_status == 429
+  assert e.value.kind == shared_faults.FaultKind.TRANSIENT
+  assert 'RESOURCE_EXHAUSTED' in str(e.value)
+  assert isinstance(e.value, shared_faults.FleetRejection)
+  # Another tenant is unaffected by tenant-a's runaway concurrency.
+  replica = bal.acquire(MODEL_TIER, client='tenant-b')
+  bal.release(replica.url, 'ok', client='tenant-b')
+  # Releasing a slot frees the quota.
+  bal.release('a:1', 'ok', client='tenant-a')
+  assert bal.acquire(MODEL_TIER, client='tenant-a').url == 'a:1'
+
+
 # ----------------------------------------------------------------------
 # Router integration (in-process HTTP fleet)
 
@@ -491,8 +671,8 @@ def test_disaggregated_bam_path_byte_identical_to_monolithic(
   np.testing.assert_array_equal(got['quals'], want['quals'])
 
   m = rc.metricz()
-  assert m['router']['n_routed_featurize'] == 1
-  assert m['latency']['featurize']['n'] == 1
+  assert m['counters']['n_routed_featurize'] == 1
+  assert m['latency']['featurize']['count'] == 1
 
 
 def test_send_phase_failure_retries_on_another_replica(fleet, params):
@@ -570,14 +750,14 @@ def test_post_send_death_is_typed_503_and_never_duplicated(
   core = router_lib.RouterCore(
       registry, router_lib.RouterOptions(max_attempts=3,
                                          upstream_timeout_s=10))
-  before = f.replicas[0][0].stats()['faults']['n_requests']
+  before = f.replicas[0][0].stats()['counters']['n_requests']
   body = protocol.request_from_features(_features(params, 'd/1/ccs'))
   with pytest.raises(shared_faults.ReplicaLostError) as e:
     core.route(body)
   assert e.value.http_status == 503
   assert e.value.kind == shared_faults.FaultKind.TRANSIENT
   assert 'never duplicated' in str(e.value)
-  after = f.replicas[0][0].stats()['faults']['n_requests']
+  after = f.replicas[0][0].stats()['counters']['n_requests']
   assert after == before  # the healthy replica never saw the request
   with registry.lock:
     assert (registry._replicas[f'127.0.0.1:{evil_port}'].state
@@ -716,7 +896,7 @@ def test_router_drain_refuses_new_work_and_exits_clean(fleet, params):
   f.router_stop.set()
   f.router_thread.join(timeout=15)
   assert f.router_stats.get('drained') is True
-  assert f.router_stats['router']['n_requests'] == 1
+  assert f.router_stats['counters']['n_requests'] == 1
 
 
 def test_fleet_down_is_typed_503_transient(fleet, params):
@@ -743,10 +923,10 @@ def test_router_metricz_aggregates_fleet(fleet, params):
     rc.polish(**_mol(params, f'm/{i}/ccs'))
   time.sleep(0.3)  # let a probe refresh cached replica counters
   m = rc.metricz()
-  assert m['router']['n_requests'] == 4
-  assert m['latency']['model']['n'] == 4
-  assert m['latency']['model']['p50_s'] is not None
-  assert m['latency']['model']['p99_s'] is not None
+  assert m['counters']['n_requests'] == 4
+  assert m['latency']['model']['count'] == 4
+  assert m['latency']['model']['p50'] is not None
+  assert m['latency']['model']['p99'] is not None
   assert {r['tier'] for r in m['replicas']} == {MODEL_TIER}
   assert m['fleet_counters'].get('n_requests', 0) == 4
   for r in m['replicas']:
@@ -827,4 +1007,215 @@ def test_featurize_worker_rejects_multi_molecule_and_garbage(
     svc.featurize(protocol.encode_bam_request(subreads_bam, ccs_bam))
   with pytest.raises(shared_faults.BadRequestError):
     svc.featurize(protocol.encode_bam_request(b'garbage', b'junk'))
-  assert svc.stats()['faults']['n_bad_requests'] == 2
+  assert svc.stats()['counters']['n_bad_requests'] == 2
+
+
+def test_router_class_headers_histograms_and_validation(fleet, params):
+  """End-to-end QoS plumbing: the client's class/client headers reach
+  admission, per-class latency histograms land in /metricz next to the
+  qos policy view, and a malformed class is a typed 400."""
+  f = fleet(n_replicas=1, client_quota=3,
+            class_weights={'interactive': 4.0, 'bulk': 1.0})
+  rc = f.client()
+  assert rc.wait_ready(10)
+  bulk = ServeClient(port=f.port, timeout=30, klass='bulk',
+                     client='tenant-a')
+  assert bulk.polish(**_mol(params, 'q/1/ccs'))['status'] == 'ok'
+  # An unlabeled request is charged to the default class.
+  assert rc.polish(**_mol(params, 'q/2/ccs'))['status'] == 'ok'
+  m = rc.metricz()
+  assert m['class_latency']['bulk']['count'] == 1
+  assert m['class_latency']['bulk']['p99'] is not None
+  assert m['class_latency']['interactive']['count'] == 1
+  qos = m['qos']
+  assert qos['client_quota'] == 3
+  assert qos['default_class'] == 'interactive'
+  assert qos['class_weights'] == {'interactive': 4.0, 'bulk': 1.0}
+  assert qos['class_in_flight'] == {}  # everything released
+  assert m['counters']['n_quota_rejected'] == 0
+  # A class value outside [a-z0-9_-]{1,32} is a typed 400, counted.
+  bad = ServeClient(port=f.port, timeout=30, klass='NOT A CLASS')
+  with pytest.raises(ServeClientError) as e:
+    bad.polish(**_mol(params, 'q/3/ccs'))
+  assert e.value.status == 400
+  assert rc.metricz()['counters']['n_bad_requests'] == 1
+
+
+def test_preemption_notice_drains_replica_and_exits_clean(
+    params, monkeypatch):
+  """The env-armed preemption notice (DCTPU_FAULT_PREEMPT_AT_S) flips
+  a serving replica into the normal drain path: serve_main returns
+  with preempted=True, drained=True — zero accepted requests lost."""
+  monkeypatch.setenv(shared_faults.ENV_PREEMPT_AT_S, '0.8')
+  runner, options = _stub_runner(params)
+  result = {}
+  ready = {}
+  t = threading.Thread(
+      target=lambda: result.update(server_lib.serve_main(
+          runner, options, ServeOptions(io_timeout_s=5.0),
+          port=0, ready_fn=ready.update)),
+      daemon=True)
+  t.start()
+  deadline = time.monotonic() + 30
+  while 'port' not in ready and time.monotonic() < deadline:
+    time.sleep(0.01)
+  assert 'port' in ready
+  # The replica serves normally until the notice lands.
+  client = ServeClient(port=ready['port'], timeout=10)
+  assert client.polish(**_mol(params, 'p/1/ccs'))['status'] == 'ok'
+  t.join(timeout=60)
+  assert not t.is_alive(), 'serve_main never exited after the notice'
+  assert result['preempted'] is True
+  assert result['drained'] is True
+
+
+# ----------------------------------------------------------------------
+# Autoscaler control law (scripted signals, no subprocesses)
+
+
+def _scaler_stats(replica_states, p99=None, queue_depth=0):
+  """A router /metricz-shaped dict: replica_states is {url: state}."""
+  return {
+      'replicas': [
+          {'url': url, 'tier': MODEL_TIER, 'state': state,
+           'queue_depth': queue_depth}
+          for url, state in replica_states.items()
+      ],
+      'class_latency': {
+          'interactive': {'p50': p99, 'p99': p99,
+                          'count': 0 if p99 is None else 8},
+      },
+      'latency': {},
+  }
+
+
+class _ScalerHarness:
+  """Injected transports for Autoscaler: a mutable stats feed plus
+  recording spawn/drain fakes."""
+
+  def __init__(self, **options):
+    self.feed = [_scaler_stats({})]
+    self.spawned = []
+    self.drained = []
+    self._n = 0
+    self.scaler = Autoscaler(
+        AutoscalerOptions(**options), self.fetch, self.spawn,
+        self.drained.append)
+
+  def fetch(self):
+    stats = self.feed[-1]
+    if isinstance(stats, Exception):
+      raise stats
+    return stats
+
+  def spawn(self):
+    url = f'10.0.0.{self._n}:1'
+    self._n += 1
+    self.spawned.append(url)
+    return url
+
+
+def test_autoscaler_scales_out_on_slo_breach_and_in_when_cold():
+  h = _ScalerHarness(min_replicas=1, max_replicas=3, target_p99_s=1.0,
+                     target_queue_depth=4.0, scale_out_cooldown_s=0.0,
+                     scale_in_cooldown_s=0.0)
+  # p99 over target: +1 replica, spawned immediately (deficit fill).
+  h.feed.append(_scaler_stats({'op:1': ReplicaState.READY}, p99=9.0))
+  d = h.scaler.tick()
+  assert d['action'] == 'scale_out'
+  assert h.scaler.target == 2
+  assert d['spawned'] == h.spawned[:1]
+  # Queue depth alone also trips the breach.
+  h.feed.append(_scaler_stats(
+      {'op:1': ReplicaState.READY, h.spawned[0]: ReplicaState.READY},
+      p99=0.1, queue_depth=50))
+  assert h.scaler.tick()['action'] == 'scale_out'
+  assert h.scaler.target == 3
+  # At max_replicas a breach holds instead of growing without bound.
+  h.feed.append(_scaler_stats(
+      {'op:1': ReplicaState.READY, h.spawned[0]: ReplicaState.READY,
+       h.spawned[1]: ReplicaState.READY}, p99=9.0))
+  assert h.scaler.tick()['action'] == 'hold'
+  assert h.scaler.target == 3
+  # Cold (both signals far under target): scale in drains the NEWEST
+  # managed replica — never the operator-started base replica.
+  h.feed.append(_scaler_stats(
+      {'op:1': ReplicaState.READY, h.spawned[0]: ReplicaState.READY,
+       h.spawned[1]: ReplicaState.READY}, p99=0.01))
+  d = h.scaler.tick()
+  assert d['action'] == 'scale_in'
+  assert d['drained'] == h.spawned[1]
+  h.feed.append(_scaler_stats(
+      {'op:1': ReplicaState.READY, h.spawned[0]: ReplicaState.READY},
+      p99=0.01))
+  assert h.scaler.tick()['drained'] == h.spawned[0]
+  assert h.drained == [h.spawned[1], h.spawned[0]]
+  # At min_replicas cold holds: the floor is never drained.
+  h.feed.append(_scaler_stats({'op:1': ReplicaState.READY}, p99=0.01))
+  assert h.scaler.tick()['action'] == 'hold'
+  assert h.scaler.target == 1
+  assert 'op:1' not in h.drained
+  counters = h.scaler.stats()['counters']
+  assert counters['n_scale_out'] == 2
+  assert counters['n_scale_in'] == 2
+  assert counters['n_spawned'] == 2
+  assert counters['n_drained'] == 2
+
+
+def test_autoscaler_replaces_preempted_capacity_and_survives_polls():
+  h = _ScalerHarness(min_replicas=2, max_replicas=4,
+                     scale_out_cooldown_s=0.0, scale_in_cooldown_s=0.0)
+  # Steady state at target: hold.
+  h.feed.append(_scaler_stats(
+      {'a:1': ReplicaState.READY, 'b:1': ReplicaState.READY}, p99=0.1))
+  assert h.scaler.tick()['action'] == 'hold'
+  assert not h.spawned
+  # b:1 takes a preemption notice -> DRAINING: it leaves the live set
+  # and the deficit is respawned the same tick.
+  h.feed.append(_scaler_stats(
+      {'a:1': ReplicaState.READY, 'b:1': ReplicaState.DRAINING},
+      p99=0.1))
+  d = h.scaler.tick()
+  assert d['action'] == 'replace'
+  assert len(h.spawned) == 1
+  assert h.scaler.stats()['counters']['n_replaced'] == 1
+  # A router poll failure skips the tick without killing the loop.
+  h.feed.append(OSError('router down'))
+  d = h.scaler.tick()
+  assert d['action'] == 'poll_error'
+  assert h.scaler.stats()['counters']['n_poll_errors'] == 1
+  assert h.scaler.target == 2
+  # Shutdown with drain_managed drains only the autoscaler's spawns.
+  h.feed.append(_scaler_stats(
+      {'a:1': ReplicaState.READY, h.spawned[0]: ReplicaState.READY},
+      p99=0.1))
+  h.scaler.tick()
+  managed = h.scaler.shutdown(drain_managed=True)
+  assert managed == h.spawned
+  assert h.drained == h.spawned
+  assert 'a:1' not in h.drained
+
+
+def test_autoscaler_cooldown_gates_scale_out_and_spawn_failures_count():
+  h = _ScalerHarness(min_replicas=1, max_replicas=4, target_p99_s=1.0,
+                     scale_out_cooldown_s=3600.0)
+  hot = _scaler_stats({'op:1': ReplicaState.READY}, p99=9.0)
+  h.feed.append(hot)
+  assert h.scaler.tick()['action'] == 'scale_out'
+  # Still hot, but inside the cooldown: the breach does not compound.
+  h.feed.append(_scaler_stats(
+      {'op:1': ReplicaState.READY, h.spawned[0]: ReplicaState.READY},
+      p99=9.0))
+  assert h.scaler.tick()['action'] == 'hold'
+  assert h.scaler.target == 2
+  assert h.scaler.stats()['counters']['n_scale_out'] == 1
+  # A failed spawn is counted and retried next tick; the deficit (and
+  # the target) persist.
+  h.scaler.spawn_fn = lambda: (_ for _ in ()).throw(OSError('no slots'))
+  h.feed.append(_scaler_stats({'op:1': ReplicaState.READY}, p99=0.1))
+  h.scaler.tick()
+  assert h.scaler.stats()['counters']['n_spawn_errors'] == 1
+  assert h.scaler.target == 2
+  h.scaler.spawn_fn = h.spawn
+  h.scaler.tick()
+  assert len(h.spawned) == 2

@@ -82,13 +82,15 @@ class TestMetricsRegistry:
     assert h.percentile(0.99) == 10.0
     assert h.percentile(0.50) == 0.01
 
-  def test_percentiles_aliases(self):
+  def test_percentiles_canonical_keys_only(self):
     h = metrics_lib.Histogram('x', threading.Lock(), bounds=(1.0,))
     assert h.percentiles()['p50'] is None
     h.observe(0.5)
     p = h.percentiles()
-    assert p['p50'] == p['p50_s'] == 1.0
-    assert p['count'] == p['n'] == 1
+    assert p['p50'] == 1.0
+    assert p['count'] == 1
+    # The one-release p50_s/p99_s/n aliases are removed.
+    assert set(p) == {'p50', 'p99', 'count'}
 
   def test_empty_histogram_rejected(self):
     with pytest.raises(ValueError):

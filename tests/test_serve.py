@@ -136,12 +136,12 @@ def test_polish_roundtrip_and_metrics(serve_ctx, params):
   assert len(resp['quals']) == len(resp['seq'])
   assert resp['counters']['n_windows_to_model'] == 4
   m = ctx.client.metricz()
-  assert m['faults']['n_requests'] == 1
-  assert m['latency']['n'] == 1
-  assert m['latency']['p50_s'] is not None
-  assert m['faults']['n_rejected_backpressure'] == 0
-  assert m['faults']['n_deadline_cancelled'] == 0
-  assert m['faults']['n_quarantined_by_request'] == 0
+  assert m['counters']['n_requests'] == 1
+  assert m['latency']['count'] == 1
+  assert m['latency']['p50'] is not None
+  assert m['counters']['n_rejected_backpressure'] == 0
+  assert m['counters']['n_deadline_cancelled'] == 0
+  assert m['counters']['n_quarantined_by_request'] == 0
 
 
 def test_concurrent_clients_byte_identical_to_solo(serve_ctx, params):
@@ -209,7 +209,7 @@ def test_mixed_width_clients_share_per_bucket_packs(serve_ctx, params):
     assert r['seq'] == s['seq'], i
     np.testing.assert_array_equal(r['quals'], s['quals'])
   m = ctx.client.metricz()
-  counters = m['faults']
+  counters = m['counters']
   assert set(map(int, counters['n_packs_by_bucket'])) == {100, 200}
   assert counters['padding_fraction'] > 0
   assert m['window_buckets'] == [100, 200]
@@ -238,8 +238,8 @@ def test_metricz_hammer_during_soak_exact_counters(serve_ctx, params):
       try:
         m = client.metricz()
         # Counters must always be internally coherent mid-soak.
-        assert 0 <= m['faults']['n_requests'] <= n_requests
-        assert 0 <= m['latency']['n'] <= n_requests
+        assert 0 <= m['counters']['n_requests'] <= n_requests
+        assert 0 <= m['latency']['count'] <= n_requests
         n_reads[0] += 1
       except Exception as e:  # noqa: BLE001 - reported via the assert
         reader_errors.append(e)
@@ -274,10 +274,10 @@ def test_metricz_hammer_during_soak_exact_counters(serve_ctx, params):
   assert not reader_errors, reader_errors[:3]
   assert n_reads[0] > 0
   m = ctx.client.metricz()
-  assert m['faults']['n_requests'] == n_requests
-  assert m['latency']['n'] == n_requests
-  assert m['faults']['n_quarantined_by_request'] == 0
-  assert m['faults']['n_deadline_cancelled'] == 0
+  assert m['counters']['n_requests'] == n_requests
+  assert m['latency']['count'] == n_requests
+  assert m['counters']['n_quarantined_by_request'] == 0
+  assert m['counters']['n_deadline_cancelled'] == 0
 
 
 def test_garbage_body_rejected_400(serve_ctx, params):
@@ -312,7 +312,7 @@ def test_mid_request_disconnect_harmless(serve_ctx, params):
   assert ctx.client.healthz()['_status'] == 200
   assert ctx.client.polish(**_mol(params, 'm/6/ccs'))['status'] == 'ok'
   # Disconnected uploads never reached admission.
-  assert ctx.client.metricz()['faults']['n_requests'] == 1
+  assert ctx.client.metricz()['counters']['n_requests'] == 1
 
 
 def test_slowloris_cut_by_io_timeout(serve_ctx, params):
@@ -352,7 +352,7 @@ def test_backpressure_429(serve_ctx, params):
   assert rejected.status == 429
   assert rejected.kind == shared_faults.FaultKind.TRANSIENT
   assert first['resp']['status'] == 'ok'  # admitted work unaffected
-  assert ctx.client.metricz()['faults']['n_rejected_backpressure'] >= 1
+  assert ctx.client.metricz()['counters']['n_rejected_backpressure'] >= 1
 
 
 def test_deadline_cancelled_504(serve_ctx, params):
@@ -365,7 +365,7 @@ def test_deadline_cancelled_504(serve_ctx, params):
   ctx.control.dispatch_delay = 0.0
   # The loop sheds the cancelled work and keeps serving.
   assert ctx.client.polish(**_mol(params, 'm/11/ccs'))['status'] == 'ok'
-  assert ctx.client.metricz()['faults']['n_deadline_cancelled'] == 1
+  assert ctx.client.metricz()['counters']['n_deadline_cancelled'] == 1
 
 
 def test_poison_quarantined_with_attribution_others_clean(
@@ -411,8 +411,8 @@ def test_poison_quarantined_with_attribution_others_clean(
   assert 'poison' in resp['error']
   assert ctx.service.healthy
   m = ctx.client.metricz()
-  assert m['faults']['n_quarantined_by_request'] == 1
-  assert m['faults']['n_isolation_retries'] >= 1
+  assert m['counters']['n_quarantined_by_request'] == 1
+  assert m['counters']['n_isolation_retries'] >= 1
   # Dead-letter carries request attribution.
   entries = [json.loads(line)
              for line in open(tmp_path / 'serve.failed.jsonl')]
@@ -472,8 +472,9 @@ def _http_get(port, path):
 
 
 def test_metricz_unified_schema(serve_ctx, params):
-  """Every tier's /metricz leads with the same top-level keys; the old
-  serve-only faults/latency splits ride along as aliases."""
+  """Every tier's /metricz leads with the same top-level keys; the
+  one-release legacy aliases (serve `faults` block, `p50_s`/`p99_s`/`n`
+  percentile keys) are gone."""
   ctx = serve_ctx()
   assert ctx.client.wait_ready(10)
   ctx.client.polish(**_mol(params, 'm/70/ccs'))
@@ -484,13 +485,14 @@ def test_metricz_unified_schema(serve_ctx, params):
   assert m['tier'] == 'serve'
   assert m['counters']['n_requests'] == 1
   assert 'serve_request_latency_s' in m['histograms']
-  # Nearest-rank percentiles under canonical AND alias keys.
+  # Nearest-rank percentiles under the canonical keys ONLY: the
+  # p50_s/p99_s/n aliases kept for one release are removed.
   lat = m['latency']
-  assert lat['p50'] == lat['p50_s'] and lat['p50'] is not None
-  assert lat['p99'] == lat['p99_s']
-  assert lat['count'] == lat['n'] == 1
-  # Legacy split still answers (one-release alias).
-  assert m['faults']['n_requests'] == 1
+  assert lat['p50'] is not None and lat['p99'] is not None
+  assert lat['count'] == 1
+  assert not {'p50_s', 'p99_s', 'n'} & set(lat)
+  # The legacy serve-only faults split is removed with them.
+  assert 'faults' not in m
 
 
 def test_metricz_prom_format(serve_ctx, params):
@@ -627,11 +629,11 @@ def test_serve_with_mesh_byte_identical_to_single_device(params):
     assert m['status'] == s['status'], i
     assert m['seq'] == s['seq'], i
     np.testing.assert_array_equal(m['quals'], s['quals'])
-  assert metrics_single['faults']['n_packs_dispatched_sharded'] == 0
-  faults = metrics_sharded['faults']
-  assert faults['n_packs_dispatched_sharded'] > 0
-  assert (faults['n_transfer_overlapped']
-          + faults['n_transfer_direct']) >= faults[
+  assert metrics_single['counters']['n_packs_dispatched_sharded'] == 0
+  counters = metrics_sharded['counters']
+  assert counters['n_packs_dispatched_sharded'] > 0
+  assert (counters['n_transfer_overlapped']
+          + counters['n_transfer_direct']) >= counters[
               'n_packs_dispatched_sharded']
 
 
@@ -704,7 +706,7 @@ def test_sigterm_drains_under_load_subprocess(params, tmp_path):
                   if k not in ('ok', 'filtered', 'http_503', 'http_429',
                                'conn_refused')}
     assert not unexpected, outcomes
-    assert drained[0]['faults']['n_deadline_cancelled'] == 0
+    assert drained[0]['counters']['n_deadline_cancelled'] == 0
   finally:
     if proc.poll() is None:
       proc.kill()

@@ -57,6 +57,7 @@ FAULT_TYPES = frozenset({
     'DispatchTimeoutError',
     'FleetRejection',
     'ReplicaLostError',
+    'QuotaExceededError',
     # deepconsensus_tpu/inference/faults.py
     'ZmwFault',
     'WatchdogTimeout',
@@ -194,6 +195,9 @@ GUARDED_BY_SCOPE = (
     'deepconsensus_tpu/inference/runner.py',
     'deepconsensus_tpu/fleet/registry.py',
     'deepconsensus_tpu/fleet/router.py',
+    # The autoscaler's control loop, ledger and decision counters are
+    # shared between its poll thread and the CLI lifecycle thread.
+    'deepconsensus_tpu/fleet/autoscaler.py',
     # TrainBatchPrefetcher's producer thread shares counters and the
     # mesh-generation with the training loop.
     'deepconsensus_tpu/models/train.py',
