@@ -53,6 +53,8 @@ _MULTICHIP_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                'MULTICHIP_r06.json')
 _MULTICHIP_R07_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), 'MULTICHIP_r07.json')
+_RAGGED_AB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               'BENCH_r09.json')
 
 
 def _write_details(details):
@@ -579,6 +581,48 @@ def _padding_waste_stage(details, budget_left, batch=256, n_windows=1024):
   _write_details(details)
 
 
+def _ragged_residency_stage(details, budget_left, batch=256,
+                            n_windows=1024):
+  """Ragged-vs-bucketed dispatch A/B over one mixed-length window
+  stream (round-13): the per-bucket packer fleet vs the single ragged
+  pack stream (use_ragged_kernel) on the same weights. The child
+  script reports windows/s, the padded-position fraction each policy
+  dispatched, n_forward_shapes (the ragged run must compile exactly
+  ONE), host-gap-per-pack from trace spans (the residency signal: a
+  device-resident loop leaves only transfer-covered compute gaps), and
+  a delivery byte-identity verdict. Byte identity, the padding
+  fraction, and the shape collapse are backend-independent, so the
+  stage also runs in CPU-fallback captures; the windows/s A/B defers
+  to real hardware (measure_r4.sh stages it as forward_ragged /
+  forward_ragged_resident). Results also land in BENCH_r09.json (the
+  round artifact the driver keeps)."""
+  repo = os.path.dirname(os.path.abspath(__file__))
+  script = os.path.join(repo, 'scripts', 'bench_ragged.py')
+  env = dict(os.environ)
+  env['PYTHONPATH'] = f"{repo}:{env.get('PYTHONPATH', '')}".rstrip(':')
+  cmd = [sys.executable, script, '--batch', str(batch),
+         '--windows', str(n_windows), '--out', _RAGGED_AB_PATH]
+  stage = {'n_windows': n_windows, 'batch': batch}
+  try:
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, env=env,
+        timeout=min(420, max(60, budget_left() - 30)))
+    lines = [json.loads(l) for l in proc.stdout.splitlines()
+             if l.startswith('{')]
+    stage['variants'] = {l['variant']: l for l in lines if 'variant' in l}
+    summary = next((l for l in lines if l.get('summary') == 'ragged_ab'),
+                   None)
+    if summary:
+      stage.update({k: v for k, v in summary.items() if k != 'summary'})
+    stage['rc'] = proc.returncode
+    if proc.returncode != 0 and not summary:
+      stage['error'] = proc.stderr.strip()[-200:]
+  except Exception as e:
+    stage['error'] = repr(e)[:200]
+  details['stages']['ragged_residency'] = stage
+  _write_details(details)
+
+
 def main():
   # CPU-fallback mode: the parent sets DC_BENCH_CPU=1 when every TPU
   # probe fails, so the round still records an honest (slow) number
@@ -663,6 +707,11 @@ def main():
     # reduction on CPU; windows/s defers to hardware.
     if budget_left() > 90:
       _padding_waste_stage(details, budget_left)
+    # Same again for the single ragged pack stream: byte identity and
+    # the 2 -> 1 forward-shape collapse are CPU-provable; the
+    # residency windows/s defers to hardware.
+    if budget_left() > 90:
+      _ragged_residency_stage(details, budget_left)
     return
 
   # Stage 2: forward throughput at the production batch size.
@@ -779,6 +828,13 @@ def main():
   # compile count per variant.
   if budget_left() > 120:
     _padding_waste_stage(details, budget_left)
+
+  # Stage 5f: single-ragged-stream vs per-bucket dispatch over the
+  # same mixed stream (round-13): windows/s, padding fraction, the
+  # 2 -> 1 forward-shape collapse, host-gap-per-pack from trace spans,
+  # and the delivery byte-identity verdict (BENCH_r09.json).
+  if budget_left() > 120:
+    _ragged_residency_stage(details, budget_left)
 
   # Stage 6: training throughput (full train step, batch 256), scan DP
   # vs Pallas wavefront-VJP loss. Opportunistic: the train-step compile

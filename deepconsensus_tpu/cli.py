@@ -213,6 +213,17 @@ def _add_bucket_flag(p):
                  'smallest bucket must equal the model max_length. '
                  'Default: the checkpoint\'s params.window_buckets '
                  '(single-shape when unset).')
+  p.add_argument('--use_ragged_kernel', action='store_true',
+                 default=False,
+                 help='Single-pack-stream ragged dispatch: pack mixed-'
+                 'width windows back-to-back into fixed-length slots '
+                 '(slot = the largest bucket) with a per-slot lengths '
+                 'vector and run ONE compiled ragged forward for every '
+                 'width — no per-bucket packer fleet, no starvation '
+                 'flush, n_forward_shapes == 1. Requires buckets that '
+                 'form a divisibility chain (the default 100,200 '
+                 'does). Off: the per-bucket packers (byte-identical '
+                 'output either way).')
 
 
 def _add_device_fault_flags(p):
@@ -856,6 +867,7 @@ def _dispatch(args) -> int:
         quantize_matmuls=args.quantize_matmuls,
         device_epilogue=args.device_epilogue,
         window_buckets=args.window_buckets,
+        use_ragged_kernel=args.use_ragged_kernel,
         dc_calibration_values=calibration_lib.parse_calibration_string(
             dc_cal or 'skip'),
         ccs_calibration_values=calibration_lib.parse_calibration_string(
@@ -1132,6 +1144,7 @@ def _dispatch(args) -> int:
         quantize_matmuls=args.quantize_matmuls,
         device_epilogue=args.device_epilogue,
         window_buckets=args.window_buckets,
+        use_ragged_kernel=args.use_ragged_kernel,
         pack_across_batches=not args.no_cross_batch_packing,
         max_record_bytes=args.max_record_bytes,
         dc_calibration_values=calibration_lib.parse_calibration_string(

@@ -162,6 +162,8 @@ class _WindowPacker:
     self.n_packs = 0
     self.n_pack_rows = 0
     self.n_pad_rows = 0
+    self.n_starvation_flushes = 0
+    self.n_flush_pad_rows = 0
     self.n_oom_bisections = 0
     self.n_device_faults = 0
     self.n_dispatch_timeouts = 0
@@ -184,6 +186,14 @@ class _WindowPacker:
     packs, cut it now as a padded partial pack rather than holding its
     windows hostage to a bucket the input stream rarely feeds."""
     if self._buffered and self._pack_clock[0] - self._starve_mark >= limit:
+      # Attribute the pad rows of this flush to starvation ONCE, here:
+      # _cut_packs -> _dispatch adds the same pads to the general
+      # n_pad_rows pool, and the end-of-input flush() cannot re-pad an
+      # already-flushed tail (buffered is 0 after the cut), so neither
+      # counter double-counts a bucket whose FINAL pack was a
+      # starvation flush.
+      self.n_starvation_flushes += 1
+      self.n_flush_pad_rows += self._batch - self._buffered
       self._cut_packs(flush=True)
 
   def poison(self, ticket: Ticket) -> None:
@@ -358,6 +368,258 @@ class _WindowPacker:
 
 
 # ----------------------------------------------------------------------
+# Single-stream ragged packer (use_ragged_kernel)
+
+
+class _RaggedPacker:
+  """One pack stream for every bucket width: mixed-width windows pack
+  into fixed [n_slots, R, slot_len, 1] slots (slot_len = the largest
+  bucket) with a per-slot int32 `lengths` vector, and dispatch through
+  the runner's ragged forward (ModelRunner.dispatch_ragged). One
+  compiled forward serves the whole run (n_forward_shapes == 1), so
+  there is no per-bucket starvation and no starvation flush: packs cut
+  only when every slot fills exactly (zero padding in steady state);
+  partial, zero-length-padded slots appear only at end-of-input flush.
+
+  Packing is greedy largest-first against the bucket divisibility
+  chain (each bucket divides every larger one, enforced by the model's
+  ragged path), so every placed window starts at a multiple of its own
+  width and the device reshape-select recovers it exactly — per-window
+  output stays byte-identical to the per-bucket packers.
+
+  Fault policy is fail-only: typed device faults are classified and
+  counted, then the whole pack routes to on_pack_failure. (Bisect /
+  mesh-degrade recovery stays a bucketed-path feature; the ragged
+  path's single compiled shape is the point.)
+  """
+
+  def __init__(self, runner, options, buckets: Tuple[int, ...],
+               timing_rows: List[Dict[str, Any]],
+               on_pack_failure: PackFailureFn, deliver: DeliverFn,
+               poisoned: Optional[set] = None,
+               pack_clock: Optional[List[int]] = None):
+    buckets = tuple(sorted(int(b) for b in buckets))
+    if not buckets or buckets[0] <= 0:
+      # dclint: allow=typed-faults (configuration contract, not a
+      # data-plane fault: buckets come from resolved model params)
+      raise ValueError(f'ragged packing needs positive buckets: {buckets}')
+    for small, large in zip(buckets, buckets[1:]):
+      if large % small:
+        # dclint: allow=typed-faults (same configuration contract —
+        # mirrors ops.ragged_window_attention.validate_ragged_buckets
+        # without importing jax into the engine)
+        raise ValueError(
+            'ragged packing needs a bucket divisibility chain '
+            f'(each bucket divides the next): {buckets}')
+    self._runner = runner
+    self._buckets = buckets
+    self._slot_len = buckets[-1]
+    self._wps = self._slot_len // buckets[0]  # windows per slot, max
+    batch = max(1, int(options.batch_size))
+    n_slots = max(1, batch // self._wps)
+    dp = int(getattr(runner, 'mesh_dp', 0) or 0)
+    if dp > 1:
+      # The compiled slot batch must split over the data axis.
+      n_slots = ((n_slots + dp - 1) // dp) * dp
+    self._n_slots = n_slots
+    self._depth = max(1, options.dispatch_depth)
+    self._timing_rows = timing_rows
+    self._on_pack_failure = on_pack_failure
+    self._deliver = deliver
+    # Per-width FIFO queues of (rows [R, w, 1], ticket): within a
+    # width, placement order == submission order, which is what the
+    # byte-identity contract pins downstream.
+    self._queues: Dict[int, 'collections.deque'] = {
+        w: collections.deque() for w in buckets}
+    self._buffered = 0
+    self._in_flight: 'collections.deque' = collections.deque()
+    self._poisoned: set = poisoned if poisoned is not None else set()
+    self._pack_clock: List[int] = (
+        pack_clock if pack_clock is not None else [0])
+    self._t_buf_start = 0.0
+    self._obs = getattr(runner, 'obs', None)
+    self.n_packs = 0
+    self.n_pack_rows = 0
+    self.n_pad_rows = 0
+    # Structurally zero on the single-stream path (no starvation
+    # flush); kept so the engine can aggregate uniformly.
+    self.n_starvation_flushes = 0
+    self.n_flush_pad_rows = 0
+    self.n_oom_bisections = 0
+    self.n_device_faults = 0
+    self.n_dispatch_timeouts = 0
+    self.model_wall = 0.0
+
+  @property
+  def slot_len(self) -> int:
+    return self._slot_len
+
+  @property
+  def n_slots(self) -> int:
+    return self._n_slots
+
+  @property
+  def windows_per_slot(self) -> int:
+    return self._wps
+
+  def add(self, rows: np.ndarray, tickets: Sequence[Ticket]) -> None:
+    """Buffers one submission's formatted rows ([k, R, w, 1], one
+    bucket width, aligned with tickets) and cuts every pack whose
+    n_slots slots can now be filled exactly."""
+    width = int(rows.shape[2])
+    queue = self._queues.get(width)
+    if queue is None:
+      # dclint: allow=typed-faults (caller shape contract: windows
+      # must arrive pre-padded to a configured bucket)
+      raise ValueError(
+          f'window width {width} not in window buckets {self._buckets}')
+    if not self._buffered:
+      self._t_buf_start = time.time()
+    for row, ticket in zip(rows, tickets):
+      queue.append((row, ticket))
+    self._buffered += len(rows)
+    self._cut_packs(flush=False)
+
+  def maybe_flush_starved(self, limit: int) -> None:
+    """No-op: one pack stream serves every width, so no bucket's tail
+    can starve behind another's traffic."""
+    del limit
+
+  def poison(self, ticket: Ticket) -> None:
+    self._poisoned.add(id(ticket))
+
+  def _plan(self, allow_partial: bool) -> Optional[List[Tuple[int, int, int]]]:
+    """Greedy largest-first slot plan: [(slot, offset, width), ...] in
+    per-width FIFO order, or None when the slots cannot all be filled
+    exactly (and partial packs are not allowed). With the divisibility
+    chain, any remaining slot capacity is a multiple of every smaller
+    bucket, so largest-first never strands capacity a different order
+    could have filled."""
+    counts = {w: len(q) for w, q in self._queues.items()}
+    plan: List[Tuple[int, int, int]] = []
+    for slot in range(self._n_slots):
+      remaining = self._slot_len
+      while remaining:
+        width = next(
+            (w for w in reversed(self._buckets)
+             if w <= remaining and counts[w]), None)
+        if width is None:
+          if allow_partial:
+            break
+          return None
+        counts[width] -= 1
+        plan.append((slot, self._slot_len - remaining, width))
+        remaining -= width
+      if allow_partial and not any(counts.values()):
+        break
+    return plan
+
+  def _cut_packs(self, flush: bool) -> None:
+    while True:
+      plan = self._plan(allow_partial=False)
+      if plan is None:
+        break
+      self._dispatch(plan)
+    while flush and self._buffered:
+      self._dispatch(self._plan(allow_partial=True))
+
+  def _dispatch(self, plan: List[Tuple[int, int, int]]) -> None:
+    seq = self.n_packs
+    self.n_packs += 1
+    self._pack_clock[0] += 1
+    t_cut = time.time()
+    obs_lib.record_stage(
+        self._obs, obs_lib.trace.STAGE_PACK_WAIT,
+        self._t_buf_start or t_cut, t_cut,
+        bucket=self._slot_len, n_rows=len(plan))
+    self._t_buf_start = t_cut
+    # Materialize the pack from the plan, popping each width's FIFO.
+    first_row = self._queues[plan[0][2]][0][0]
+    n_rows = first_row.shape[0]
+    pack = np.zeros((self._n_slots, n_rows, self._slot_len, 1),
+                    dtype=np.float32)
+    lengths = np.zeros((self._n_slots, self._wps), dtype=np.int32)
+    slot_fill = [0] * self._n_slots
+    placements: List[Tuple[Ticket, int, int, int]] = []
+    used = 0
+    for slot, off, width in plan:
+      row, ticket = self._queues[width].popleft()
+      pack[slot, :, off:off + width] = row
+      lengths[slot, slot_fill[slot]] = width
+      slot_fill[slot] += 1
+      placements.append((ticket, slot, off, width))
+      used += width
+    self._buffered -= len(placements)
+    self.n_pack_rows += len(placements)
+    # Unused position capacity in min-bucket units: the windows a full
+    # pack of the same shape could additionally have carried.
+    self.n_pad_rows += (
+        self._n_slots * self._slot_len - used) // self._buckets[0]
+    tickets = [p[0] for p in placements]
+    try:
+      if self._poisoned:
+        hit = [t for t in tickets if id(t) in self._poisoned]
+        if hit:
+          for t in hit:
+            self._poisoned.discard(id(t))
+          # dclint: allow=typed-faults (fault-injection hook: must be
+          # a bare RuntimeError so it trips the pack-failure path the
+          # same way a real dispatch error would)
+          raise RuntimeError(
+              'injected poison window payload '
+              f'({faults_lib.ENV_POISON_WINDOW}; {len(hit)} window(s) '
+              f'in ragged pack {seq})')
+      handle = self._runner.dispatch_ragged(pack, lengths)
+    except Exception as e:
+      self._handle_pack_fault(placements, seq, e)
+      return
+    self._in_flight.append((handle, placements, seq))
+    while len(self._in_flight) > self._depth:
+      self._drain_one()
+
+  def _drain_one(self) -> None:
+    handle, placements, seq = self._in_flight.popleft()
+    t0 = time.time()
+    try:
+      pred_ids, quality = self._runner.finalize(handle)
+    except Exception as e:
+      self._handle_pack_fault(placements, seq, e)
+      return
+    # uint8 transport into the stitch plane (values are 0..4 / 0..93).
+    ids_u8 = pred_ids.astype(np.uint8)
+    quals_u8 = quality.astype(np.uint8)
+    elapsed = time.time() - t0
+    self.model_wall += elapsed
+    for ticket, slot, off, width in placements:
+      self._deliver(ticket, ids_u8[slot, off:off + width],
+                    quals_u8[slot, off:off + width])
+    self._timing_rows.append(dict(
+        stage='run_model', runtime=elapsed, n_zmws=0,
+        n_examples=len(placements), n_subreads=0))
+
+  def _handle_pack_fault(self, placements, seq: int,
+                         error: BaseException) -> None:
+    error = faults_lib.classify_device_error(error)
+    if isinstance(error, faults_lib.DeviceFault):
+      self.n_device_faults += 1
+      if isinstance(error, faults_lib.DispatchTimeoutError):
+        self.n_dispatch_timeouts += 1
+    self._on_pack_failure([p[0] for p in placements], seq, error)
+
+  def flush(self, drain: bool = True) -> None:
+    """Cuts the buffered tail as final (zero-length-padded) packs;
+    with drain, also resolves every in-flight pack (end of input).
+    The ONLY place partial packs exist on the ragged path."""
+    self._cut_packs(flush=True)
+    while drain and self._in_flight:
+      self._drain_one()
+
+  @property
+  def has_work(self) -> bool:
+    return bool(self._buffered or self._in_flight)
+
+
+# ----------------------------------------------------------------------
 # The engine
 
 
@@ -397,6 +659,11 @@ class ConsensusEngine:
     self._poisoned: set = set()
     self._pack_clock: List[int] = [0]
     self._n_windows_by_bucket: Dict[int, int] = {}
+    # use_ragged_kernel: ONE pack stream for every width — a single
+    # _RaggedPacker replaces the per-bucket fleet, and every pack
+    # dispatches at the same [n_slots, R, slot_len] shape.
+    self._ragged = bool(getattr(options, 'use_ragged_kernel', False))
+    self._ragged_packer: Optional[_RaggedPacker] = None
 
   def _resolve_buckets(self) -> Tuple[int, ...]:
     buckets = getattr(self.options, 'window_buckets', None)
@@ -413,7 +680,20 @@ class ConsensusEngine:
   def window_buckets(self) -> Tuple[int, ...]:
     return self._buckets
 
-  def _packer_for(self, width: int) -> _WindowPacker:
+  def _packer_for(self, width: int):
+    if self._ragged:
+      if width not in self._buckets:
+        # dclint: allow=typed-faults (caller shape contract: windows
+        # must arrive pre-padded to a configured bucket)
+        raise ValueError(
+            f'window width {width} not in window buckets {self._buckets}')
+      if self._ragged_packer is None:
+        self._ragged_packer = _RaggedPacker(
+            self.runner, self.options, self._buckets, self.timing_rows,
+            lambda ts, seq, err: self._on_pack_failure(ts, seq, err),
+            lambda t, ids, quals: self._deliver_fn(t, ids, quals),
+            poisoned=self._poisoned, pack_clock=self._pack_clock)
+      return self._ragged_packer
     packer = self._packers.get(width)
     if packer is None:
       if width not in self._buckets:
@@ -437,7 +717,17 @@ class ConsensusEngine:
         self._n_windows_by_bucket.get(width, 0) + len(rows))
     self._packer_for(width).add(rows, tickets)
 
+  def _all_packers(self) -> List[Any]:
+    """Every live packer: the per-bucket fleet, or the one ragged
+    packer. Counter aggregation and flush iterate this so neither path
+    double-counts."""
+    if self._ragged:
+      return [self._ragged_packer] if self._ragged_packer else []
+    return [self._packers[w] for w in sorted(self._packers)]
+
   def _flush_starved(self) -> None:
+    if self._ragged:
+      return  # single pack stream: no bucket can starve
     limit = int(getattr(self.options, 'bucket_flush_packs', 0) or 0)
     if limit <= 0 or len(self._packers) < 2:
       return
@@ -541,11 +831,11 @@ class ConsensusEngine:
     resolves every in-flight pack (every submitted ticket has been
     delivered or failed when this returns). Tails cut for all buckets
     before any drain so the end-of-input packs overlap on device."""
-    for width in sorted(self._packers):
-      self._packers[width].flush(drain=False)
+    for packer in self._all_packers():
+      packer.flush(drain=False)
     if drain:
-      for width in sorted(self._packers):
-        self._packers[width].flush(drain=True)
+      for packer in self._all_packers():
+        packer.flush(drain=True)
 
   def poison_ticket(self, ticket: Ticket) -> None:
     # Shared across buckets: the caller doesn't know (or care) which
@@ -555,10 +845,10 @@ class ConsensusEngine:
   @property
   def has_work(self) -> bool:
     """True while any submitted window is still buffered or in flight."""
-    return any(p.has_work for p in self._packers.values())
+    return any(p.has_work for p in self._all_packers())
 
   def _agg(self, name: str):
-    return sum(getattr(p, name) for p in self._packers.values())
+    return sum(getattr(p, name) for p in self._all_packers())
 
   @property
   def n_packs(self) -> int:
@@ -589,8 +879,28 @@ class ConsensusEngine:
     return self._agg('n_dispatch_timeouts')
 
   @property
+  def n_starvation_flushes(self) -> int:
+    return self._agg('n_starvation_flushes')
+
+  @property
   def n_packs_by_bucket(self) -> Dict[int, int]:
+    if self._ragged:
+      packer = self._ragged_packer
+      return {packer.slot_len: packer.n_packs} if packer else {}
     return {w: self._packers[w].n_packs for w in sorted(self._packers)}
+
+  @property
+  def flush_padding_fraction(self) -> float:
+    """Fraction of all dispatched positions that were starvation-flush
+    padding: sum_b(n_flush_pad_rows_b * L_b) / sum_b(n_packs_b * B * L_b).
+    Separates the cost of the bucket_flush_packs policy from ordinary
+    end-of-input padding; structurally 0.0 on the ragged path."""
+    dispatched = 0
+    flushed = 0
+    for width, packer in sorted(self._packers.items()):
+      dispatched += packer.n_packs * packer._batch * width
+      flushed += packer.n_flush_pad_rows * width
+    return flushed / dispatched if dispatched else 0.0
 
   @property
   def padding_fraction(self) -> float:
@@ -609,6 +919,8 @@ class ConsensusEngine:
         'n_model_packs': self.n_packs,
         'n_model_pack_rows': self.n_pack_rows,
         'n_model_pad_rows': self.n_pad_rows,
+        'n_starvation_flushes': self.n_starvation_flushes,
+        'flush_padding_fraction': round(self.flush_padding_fraction, 4),
         'model_wall_s': round(self.model_wall, 3),
         'n_oom_bisections': self.n_oom_bisections,
         'n_device_faults': self.n_device_faults,
@@ -622,6 +934,7 @@ class ConsensusEngine:
     # Bucketed-dispatch counters (after the runner merge: the engine's
     # per-packer view is authoritative for pack accounting).
     out['window_buckets'] = list(self._buckets)
+    out['use_ragged_kernel'] = int(self._ragged)
     out['n_packs_by_bucket'] = self.n_packs_by_bucket
     out['n_windows_by_bucket'] = {
         w: self._n_windows_by_bucket[w]

@@ -86,6 +86,15 @@ class InferenceOptions:
   # windows cannot be held back indefinitely behind a busy one
   # (0 disables; tails always flush at end-of-input regardless).
   bucket_flush_packs: int = 8
+  # Single-pack-stream ragged dispatch: mixed-width windows pack
+  # back-to-back into fixed [n_slots, R, slot_len] slots (slot_len =
+  # the largest bucket) with a per-slot int32 lengths vector, and ONE
+  # compiled ragged forward serves every width (n_forward_shapes == 1;
+  # no per-bucket packer fleet, no starvation flush — partial packs
+  # exist only at end-of-input). Requires the buckets to form a
+  # divisibility chain (each bucket divides the next); the bucketed
+  # path remains the byte-identical fallback when False.
+  use_ragged_kernel: bool = False
   max_base_quality: int = 93
   limit: int = 0
   # (i, n): keep only ZMWs with zm % n == i — single-flag fleet scaling
@@ -200,6 +209,31 @@ def _assemble_rows(main_u8: jnp.ndarray, sn: jnp.ndarray,
   sn_rows = jnp.broadcast_to(
       sn.astype(jnp.float32)[:, :, None, None], (b, _SN_ROWS, l, 1)
   )
+  return jnp.concatenate([main, sn_rows], axis=1)
+
+
+def _assemble_rows_ragged(main_u8: jnp.ndarray, sn_w: jnp.ndarray,
+                          lengths: jnp.ndarray,
+                          bq_row: Optional[int] = None) -> jnp.ndarray:
+  """_assemble_rows for ragged slots: SN constants vary per WINDOW
+  within a slot, so sn_w carries [B, wps, 4] per-window scalars and
+  each position gathers its own window's values through the
+  lengths-derived segment map (same slot_geometry the mask uses).
+  Positions past the packed windows get zero SN (they are masked out
+  of attention and sliced away at delivery)."""
+  from deepconsensus_tpu.ops import ragged_window_attention as ragged_ops
+
+  b, _, l, _ = main_u8.shape
+  main = main_u8.astype(jnp.float32)
+  if bq_row is not None:
+    main = main.at[:, bq_row].add(-1.0)
+  seg, _start, _width, valid = ragged_ops.slot_geometry(lengths, l)
+  # seg is always in [0, wps) (invalid positions keep segment 0), so
+  # the gather needs no clip; valid zeroes what it fetched there.
+  sn_pos = jnp.take_along_axis(
+      sn_w.astype(jnp.float32), seg[:, :, None], axis=1)  # [B, l, 4]
+  sn_pos = jnp.where(valid[:, :, None], sn_pos, 0.0)
+  sn_rows = jnp.transpose(sn_pos, (0, 2, 1))[:, :, :, None]
   return jnp.concatenate([main, sn_rows], axis=1)
 
 
@@ -354,17 +388,18 @@ class _DispatchHandle:
   """
 
   __slots__ = ('inputs', 'n', 'outputs', 'error', 'seq', 'hang_s',
-               't_launch', 'bucket')
+               't_launch', 'bucket', 'ragged')
 
   def __init__(self, inputs, n: int):
-    self.inputs = inputs  # (main_u8_dev, sn_dev); cleared at launch
+    self.inputs = inputs  # device input tuple; cleared at launch
     self.n = n
     self.outputs = None  # (pred_ids_dev, max_prob_dev) once launched
     self.error = None
     self.seq = 0  # 1-based dispatch ordinal (fault-injection target)
     self.hang_s = 0.0  # injected finalize hang (watchdog drills)
     self.t_launch = 0.0  # forward-launch wall stamp (device_compute span)
-    self.bucket = 0  # window width (straggler context in traces)
+    self.bucket = 0  # window width / slot length (straggler context)
+    self.ragged = False  # routes the launch to the ragged forward
 
   @property
   def launched(self) -> bool:
@@ -475,6 +510,15 @@ class ModelRunner:
             )
             for key, value in variables.items()
         }
+    elif variables:
+      # Single-device residency: pin the weights (and the quant
+      # collections) on the device once, same as the mesh branch —
+      # otherwise every forward re-transfers the host arrays, leaving
+      # a host gap between consecutive packs' device_compute spans.
+      # With the input buffers donated, the steady-state pack loop
+      # then touches the host only for the uint8 pack in and the
+      # uint8 (ids, quals) planes out.
+      self.variables = jax.device_put(variables)
     model = model_lib.get_model(params)
     self._bq_row = _bq_row_index(params)
     bq_row = self._bq_row
@@ -499,10 +543,26 @@ class ModelRunner:
       max_prob = jnp.max(preds, axis=-1)
       return pred_ids, max_prob
 
+    def ragged_forward(variables, main_u8, sn_w, lengths):
+      rows = _assemble_rows_ragged(main_u8, sn_w, lengths, bq_row)
+      preds = model.apply(variables, rows, window_lengths=lengths)
+      if thresholds is not None:
+        return output_plane.phred_epilogue(
+            preds, thresholds, use_pallas=pallas_epilogue)
+      pred_ids = jnp.argmax(preds, axis=-1).astype(jnp.int32)
+      max_prob = jnp.max(preds, axis=-1)
+      return pred_ids, max_prob
+
     # Retained so degrade_mesh() can recompile the same forward for a
     # rebuilt (smaller) mesh.
     self._make_forward = lambda m: self._jit_forward(forward, m)
     self._forward = self._make_forward(mesh)
+    # The ragged forward compiles lazily at its first dispatch_ragged,
+    # so wiring it up always costs nothing when use_ragged_kernel is
+    # off (jit() does not trace).
+    self._make_ragged_forward = (
+        lambda m: self._jit_ragged_forward(ragged_forward, m))
+    self._ragged_forward = self._make_ragged_forward(mesh)
     self._init_dispatch_state(mesh)
 
   def _configure_epilogue(self) -> None:
@@ -586,6 +646,11 @@ class ModelRunner:
     # bisection retries, unlike the engine's per-packer n_packs).
     self._forward_shapes: set = set()
     self._n_dispatched_by_bucket: Dict[int, int] = {}
+    # Ragged dispatch contract: absent on exported-artifact runners
+    # (the baked program has no lengths input), present on checkpoint
+    # runners regardless of the gate (jit never traces unless called).
+    self._ragged_forward = getattr(self, '_ragged_forward', None)
+    self._make_ragged_forward = getattr(self, '_make_ragged_forward', None)
 
   @staticmethod
   def _jit_forward(forward, mesh):
@@ -605,6 +670,24 @@ class ModelRunner:
         in_shardings=(None, batch_sh, batch_sh),
         out_shardings=(batch_sh, batch_sh),
         donate_argnums=(1, 2),
+    )
+
+  @staticmethod
+  def _jit_ragged_forward(forward, mesh):
+    # Same donation contract as _jit_forward, with the lengths vector
+    # riding along: all three pack buffers (uint8 rows, per-window SN,
+    # int32 lengths) are dead after the forward, so steady state
+    # cycles ONE set of donated device buffers across packs.
+    if mesh is None:
+      return jax.jit(forward, donate_argnums=(1, 2, 3))
+    from deepconsensus_tpu.parallel import mesh as mesh_lib
+
+    batch_sh = mesh_lib.batch_sharding(mesh)
+    return jax.jit(
+        forward,
+        in_shardings=(None, batch_sh, batch_sh, batch_sh),
+        out_shardings=(batch_sh, batch_sh),
+        donate_argnums=(1, 2, 3),
     )
 
   @classmethod
@@ -802,6 +885,74 @@ class ModelRunner:
     self._pending = handle
     return handle
 
+  def dispatch_ragged(self, rows: np.ndarray,
+                      lengths: np.ndarray) -> _DispatchHandle:
+    """dispatch() for the single ragged pack stream: rows
+    [n_slots, R, slot_len, 1] with mixed-width windows packed
+    back-to-back per slot, lengths [n_slots, wps] int32 window widths
+    (0 = unused capacity). Same compact uint8 transport and
+    double-buffered launch as dispatch(), with the SN plane shipped as
+    PER-WINDOW scalars ([n_slots, wps, 4], sampled at each window's
+    start column) that _assemble_rows_ragged re-broadcasts through the
+    lengths-derived segment map. Every pack has the same shape, so the
+    jitted ragged forward compiles exactly once (n_forward_shapes
+    stays 1 for the whole run)."""
+    if self._ragged_forward is None:
+      # dclint: allow=typed-faults (serving contract: exported
+      # artifacts bake a fixed-shape program with no lengths input)
+      raise ValueError(
+          'ragged dispatch is not available on this runner (exported '
+          'artifacts serve the bucketed path only)')
+    n_slots = int(rows.shape[0])
+    slot_len = int(rows.shape[2])
+    lengths = np.ascontiguousarray(np.asarray(lengths, dtype=np.int32))
+    main = rows[:, :-_SN_ROWS]
+    main_u8 = main.astype(np.uint8)
+    if self._bq_row is not None:
+      # Same lossless +1 bias as dispatch(); zero pad positions round-
+      # trip 0 -> 1 -> 0 through the device-side -1.
+      main_u8[:, self._bq_row] = (main[:, self._bq_row] + 1.0).astype(
+          np.uint8)
+    # Per-window SN scalars, sampled at each window's start column
+    # (the packer broadcast them across the window, like the raw
+    # feature layout). Empty window slots carry zeros.
+    starts = np.zeros_like(lengths)
+    starts[:, 1:] = np.cumsum(lengths[:, :-1], axis=1)
+    sn_planes = rows[:, -_SN_ROWS:, :, 0]  # [n_slots, 4, slot_len]
+    sn_w = np.take_along_axis(
+        sn_planes, np.clip(starts, 0, slot_len - 1)[:, None, :], axis=2)
+    sn_w = sn_w.transpose(0, 2, 1) * (lengths > 0)[:, :, None]
+    sn_w = np.ascontiguousarray(sn_w.astype(np.float32))
+    n_windows = int((lengths > 0).sum())
+    self._launch_pending()
+    t_h2d = time.time()
+    if self._input_sharding is not None:
+      main_dev = jax.device_put(main_u8, self._input_sharding)
+      sn_dev = jax.device_put(sn_w, self._input_sharding)
+      len_dev = jax.device_put(lengths, self._input_sharding)
+      self._n_dispatched_sharded += 1
+    else:
+      main_dev = jax.device_put(main_u8)
+      sn_dev = jax.device_put(sn_w)
+      len_dev = jax.device_put(lengths)
+    self._n_dispatched += 1
+    obs_lib.record_stage(self.obs, obs_lib.trace.STAGE_H2D,
+                         t_h2d, time.time(), pack=self._n_dispatched,
+                         bucket=slot_len, dp=self.mesh_dp,
+                         n_rows=n_windows)
+    if self._device_epilogue:
+      self._n_epilogue_packs += 1
+    # One entry for the whole run: the collapse the ragged path buys.
+    self._forward_shapes.add(('ragged', n_slots, slot_len))
+    self._n_dispatched_by_bucket[slot_len] = (
+        self._n_dispatched_by_bucket.get(slot_len, 0) + 1)
+    handle = _DispatchHandle((main_dev, sn_dev, len_dev), n_slots)
+    handle.seq = self._n_dispatched
+    handle.bucket = slot_len
+    handle.ragged = True
+    self._pending = handle
+    return handle
+
   def _launch_pending(self) -> None:
     """Launches the forward for the pack currently in the transfer
     slot, if any (the overlapped half of the double buffer)."""
@@ -816,7 +967,7 @@ class ModelRunner:
     stored on the handle (re-raised by raw_outputs/finalize) so the
     engine attributes it to the failing pack, not to whichever later
     dispatch happened to trigger this launch."""
-    main_dev, sn_dev = handle.inputs
+    inputs = handle.inputs
     # Drop our references before the call: the jit donates these
     # buffers, so they must not be reachable (or reused) afterwards.
     handle.inputs = None
@@ -824,10 +975,11 @@ class ModelRunner:
     # launch-before-finalize ordering is the span-derived overlap
     # signal dctpu trace reconciles against the counters.
     handle.t_launch = time.time()
+    fwd = self._ragged_forward if handle.ragged else self._forward
     try:
       faults.injected_device_fault(handle.seq)
       handle.hang_s = faults.injected_device_hang(handle.seq)
-      handle.outputs = self._forward(self.variables, main_dev, sn_dev)
+      handle.outputs = fwd(self.variables, *inputs)
     # dclint: allow=typed-faults (deferred-launch error capture: the
     # classified error is re-raised at finalize time, where
     # pack-failure routing can attribute it to the right tickets)
@@ -922,6 +1074,8 @@ class ModelRunner:
       }
     self.mesh = mesh
     self._forward = self._make_forward(mesh)
+    if self._make_ragged_forward is not None:
+      self._ragged_forward = self._make_ragged_forward(mesh)
     self._input_sharding = mesh_lib.batch_sharding(mesh)
     self._pending = None
     self._n_degraded += 1
@@ -2000,6 +2154,10 @@ def run_inference(
           window_counter['n_model_packs'] = engine.n_packs
           window_counter['n_model_pack_rows'] = engine.n_pack_rows
           window_counter['n_model_pad_rows'] = engine.n_pad_rows
+          window_counter['n_starvation_flushes'] = (
+              engine.n_starvation_flushes)
+          window_counter['flush_padding_fraction'] = round(
+              engine.flush_padding_fraction, 4)
           window_counter['n_oom_bisections'] = engine.n_oom_bisections
           window_counter['n_device_faults'] = engine.n_device_faults
           window_counter['n_dispatch_timeouts'] = (

@@ -111,7 +111,9 @@ class BandedSelfAttention(nn.Module):
   softmax_dtype: Any = jnp.float32
 
   @nn.compact
-  def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+  def __call__(self, x: jnp.ndarray, deterministic: bool,
+               ragged_widths: Optional[jnp.ndarray] = None,
+               ragged_buckets: Optional[tuple] = None) -> jnp.ndarray:
     if self.hidden_size % self.num_heads:
       raise ValueError('hidden_size must be divisible by num_heads')
     head_dim = self.hidden_size // self.num_heads
@@ -126,6 +128,45 @@ class BandedSelfAttention(nn.Module):
     query = dense('query')(x) * (head_dim**-0.5)
     key = dense('key')(x)
     value = dense('value')(x)
+
+    if ragged_widths is not None:
+      # Ragged slots (inference, use_ragged_kernel): x holds windows of
+      # bucket widths packed back-to-back into slots of length S, every
+      # window starting at a multiple of its own width (the divisibility
+      # -chain packing invariant). The projections above are position-
+      # wise, so reshaping [B, S] to [B*S/w, w] recovers each width-w
+      # window as one contiguous attention batch whose compute is THE
+      # SAME SHAPE as the bucketed path's — XLA produces bitwise-equal
+      # outputs (a masked wide softmax would not: reassociating the
+      # reduction over a different contraction length drifts 1 ulp).
+      # Each position then selects the candidate from its own width.
+      out = jnp.zeros(query.shape, query.dtype)
+      bsz, length = x.shape[0], x.shape[1]
+      for w in ragged_buckets:
+        n = bsz * length // w
+        shaped = lambda a: a.reshape(n, w, self.num_heads, head_dim)
+        logits = jnp.einsum('BTNH,BFNH->BNFT', shaped(key), shaped(query))
+        if self.attn_win_size:
+          i = np.arange(w)
+          band = np.abs(i[:, None] - i[None, :]) <= self.attn_win_size
+          logits = jnp.where(band[None, None, :, :], logits, -1e9)
+        weights = jax.nn.softmax(
+            logits.astype(self.softmax_dtype), axis=-1
+        ).astype(self.dtype)
+        cand = jnp.einsum(
+            'BNFT,BTNH->BFNH', weights, shaped(value)
+        ).reshape(bsz, length, self.num_heads, head_dim)
+        out = out + jnp.where(
+            (ragged_widths == w)[:, :, None, None], cand,
+            jnp.zeros((), cand.dtype))
+      return nn.DenseGeneral(
+          features=self.hidden_size,
+          axis=(-2, -1),
+          use_bias=False,
+          dtype=self.dtype,
+          kernel_init=nn.initializers.glorot_uniform(),
+          name='output_transform',
+      )(out)
 
     use_dropout = not deterministic and self.dropout_rate > 0.0
     use_pallas = self.use_pallas
@@ -221,12 +262,13 @@ class ResidualWrapper(nn.Module):
   dropout_rate: float
 
   @nn.compact
-  def __call__(self, x: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+  def __call__(self, x: jnp.ndarray, deterministic: bool,
+               **sublayer_kwargs) -> jnp.ndarray:
     if self.rezero:
       y = x
     else:
       y = nn.LayerNorm(epsilon=1e-6, dtype=jnp.float32, name='layer_norm')(x)
-    y = self.sublayer(y, deterministic=deterministic)
+    y = self.sublayer(y, deterministic=deterministic, **sublayer_kwargs)
     y = nn.Dropout(rate=self.dropout_rate)(y, deterministic=deterministic)
     if self.rezero:
       alpha = self.param('alpha', nn.initializers.zeros, (), jnp.float32)
@@ -244,7 +286,9 @@ class EncoderStack(nn.Module):
   @nn.compact
   def __call__(self, x: jnp.ndarray, deterministic: bool,
                skip_first_attention: bool = False,
-               skip_blocks: bool = False) -> jnp.ndarray:
+               skip_blocks: bool = False,
+               ragged_widths: Optional[jnp.ndarray] = None,
+               ragged_buckets: Optional[tuple] = None) -> jnp.ndarray:
     p = self.params
 
     if skip_blocks:
@@ -260,11 +304,19 @@ class EncoderStack(nn.Module):
     # activations and recompute them in the backward pass, trading
     # FLOPs for HBM so long-window/large-batch runs fit
     # (params.remat; jax.checkpoint under the hood).
-    def run_block(wrapper, x):
-      return wrapper(x, deterministic=deterministic)
+    def run_block(wrapper, x, **kw):
+      return wrapper(x, deterministic=deterministic, **kw)
 
-    if p.get('remat', False):
+    # Ragged routing is inference-only; remat is a training lever and
+    # would treat the static bucket tuple as traced args, so the two
+    # never compose.
+    if p.get('remat', False) and ragged_widths is None:
       run_block = nn.remat(run_block)
+
+    attn_kwargs = {}
+    if ragged_widths is not None:
+      attn_kwargs = dict(ragged_widths=ragged_widths,
+                         ragged_buckets=ragged_buckets)
 
     for n in range(p.num_hidden_layers):
       if skip_first_attention and n == 0:
@@ -292,6 +344,7 @@ class EncoderStack(nn.Module):
                 name=f'attention_wrapper_{n}',
             ),
             x,
+            **attn_kwargs,
         )
       ffn = FeedForward(
           hidden_size=p.hidden_size,
@@ -472,12 +525,16 @@ class DeepConsensusModel(nn.Module):
     alpha = wrap0['alpha']
     return x_base + alpha.astype(x_base.dtype) * attn_out
 
-  def _fused_encoder_blocks(self, x: jnp.ndarray) -> jnp.ndarray:
+  def _fused_encoder_blocks(
+      self, x: jnp.ndarray,
+      lengths: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Run every remaining encoder block (layer-0 FFN onward) through
     the fused Pallas block kernel (ops/fused_encoder_block.py); the
     caller finishes with the encoder's output LayerNorm
     (skip_blocks=True). int8-quantized matmul weights ride in from the
-    'quant' collection when params.quantize_matmuls is set."""
+    'quant' collection when params.quantize_matmuls is set. lengths:
+    per-slot window widths for ragged slots (every attention block
+    masks with the lengths-derived ragged mask)."""
     from deepconsensus_tpu.ops import fused_encoder_block as feb
 
     p = self.params
@@ -498,21 +555,140 @@ class DeepConsensusModel(nn.Module):
         softmax_dtype=jnp.dtype(p.get('attn_softmax_dtype', None)
                                 or 'float32'),
         compute_dtype=self.compute_dtype,
+        lengths=lengths,
     )
 
+  def _ragged_hotpath_eligible(self, rows: jnp.ndarray) -> bool:
+    """Fused-kernel eligibility for ragged slots: same levers as
+    _fused_hotpath_eligible except the window-length bound — slots
+    span the LARGEST bucket, so the ragged kernel carries its own
+    (higher) slot-length ceiling."""
+    from deepconsensus_tpu.ops import ragged_window_attention as rwa
+
+    p = self.params
+    return bool(
+        p.get('use_fused_hotpath', False)
+        and not self.is_initializing()
+        and self.learn_values
+        and p.condense_transformer_input
+        and p.rezero
+        and p.num_hidden_layers >= 1
+        and rows.shape[-1] <= rwa.RAGGED_MAX_SLOT_LEN
+    )
+
+  def _ragged_fused_forward(self, rows: jnp.ndarray,
+                            lengths: jnp.ndarray) -> jnp.ndarray:
+    """Embed+condense+pos+layer-0 attention over ragged slots via the
+    ragged Pallas kernel (ops/ragged_window_attention.py); mirrors
+    _fused_forward's weight plumbing and residual split."""
+    from deepconsensus_tpu.ops import fused_window_attention as fwa
+    from deepconsensus_tpu.ops import ragged_window_attention as rwa
+
+    p = self.params
+    specs, table_keys, _ = fwa.build_family_specs(p)
+    params = self.variables['params']
+    tables = {k: params[f'{k}_embedding']['embedding'] for k in table_keys}
+    h = p.hidden_size
+    attn0 = params['encoder']['self_attention_0']
+    wrap0 = params['encoder']['attention_wrapper_0']
+    pos = None
+    if p.add_pos_encoding:
+      # dclint: allow=dtype-downcast (position encodings enter the
+      # fused kernel at the configured compute dtype)
+      pos = jnp.asarray(
+          sinusoidal_position_encoding(rows.shape[-1], h),
+          self.compute_dtype)
+    x_base, attn_out = rwa.ragged_embed_condense_attention(
+        rows,
+        lengths,
+        tables,
+        params['condenser']['kernel'],
+        attn0['query']['kernel'].reshape(h, h),
+        attn0['key']['kernel'].reshape(h, h),
+        attn0['value']['kernel'].reshape(h, h),
+        attn0['output_transform']['kernel'].reshape(h, h),
+        pos,
+        specs=specs,
+        table_keys=table_keys,
+        num_heads=p.num_heads,
+        attn_win_size=p.attn_win_size or None,
+        softmax_dtype=jnp.dtype(p.get('attn_softmax_dtype', None)
+                                or 'float32'),
+        compute_dtype=self.compute_dtype,
+    )
+    alpha = wrap0['alpha']
+    return x_base + alpha.astype(x_base.dtype) * attn_out
+
+  def _ragged_forward_with_intermediates(
+      self, rows: jnp.ndarray,
+      window_lengths: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Single-shape ragged forward: rows [B, R, S] with mixed-width
+    windows packed back-to-back per slot, window_lengths [B, wps] the
+    per-slot widths. The XLA route is bitwise-identical per position
+    to the bucketed forward at each window's own width (reshape-select
+    attention + exact per-position pos gather); the Pallas route (when
+    use_fused_hotpath is on) is the ragged kernel pair, allclose-
+    validated against the reference in interpret mode."""
+    from deepconsensus_tpu.models import config as config_lib
+    from deepconsensus_tpu.ops import ragged_window_attention as rwa
+
+    p = self.params
+    if not self.learn_values:
+      raise ValueError('ragged forward requires the learn_values model')
+    slot_len = rows.shape[-1]
+    # Only widths that tile the slot can be recovered by reshape; the
+    # packer feeds exactly these (slot_len is the largest bucket of a
+    # divisibility chain, so normally every bucket qualifies).
+    buckets = rwa.validate_ragged_buckets(
+        tuple(b for b in config_lib.resolve_window_buckets(p)
+              if slot_len % b == 0))
+    lengths = jnp.asarray(window_lengths, jnp.int32)
+    if self._ragged_hotpath_eligible(rows):
+      x = self._ragged_fused_forward(rows, lengths)
+      x = self._fused_encoder_blocks(x, lengths=lengths)
+      encoded = self.encoder(x, deterministic=True, skip_blocks=True)
+      logits = self.logits_layer(encoded.astype(jnp.float32))
+      preds = jax.nn.softmax(logits, axis=-1)
+      return {'final_output': encoded, 'logits': logits, 'preds': preds}
+    _seg, start, width, valid = rwa.slot_geometry(lengths, slot_len)
+    x = self._embed_rows(rows)
+    if p.condense_transformer_input:
+      x = self.condenser(x)
+    if p.add_pos_encoding:
+      pos = jnp.asarray(
+          sinusoidal_position_encoding(slot_len, x.shape[2]), x.dtype)
+      off = jnp.clip(
+          jnp.arange(slot_len, dtype=jnp.int32)[None, :] - start,
+          0, slot_len - 1)
+      # Per-position gather pos[p - window_start(p)]: the same value
+      # (and the same single add) the bucketed path applies at this
+      # position's window offset, so the sum is bitwise-equal.
+      x = x + jnp.where(valid[:, :, None], jnp.take(pos, off, axis=0),
+                        jnp.zeros((), x.dtype))
+    encoded = self.encoder(x, deterministic=True, ragged_widths=width,
+                           ragged_buckets=buckets)
+    logits = self.logits_layer(encoded.astype(jnp.float32))
+    preds = jax.nn.softmax(logits, axis=-1)
+    return {'final_output': encoded, 'logits': logits, 'preds': preds}
+
   def __call__(
-      self, rows: jnp.ndarray, train: bool = False
+      self, rows: jnp.ndarray, train: bool = False,
+      window_lengths: Optional[jnp.ndarray] = None
   ) -> jnp.ndarray:
-    return self.apply_with_intermediates(rows, train)['preds']
+    return self.apply_with_intermediates(
+        rows, train, window_lengths=window_lengths)['preds']
 
   @nn.compact_name_scope
   def apply_with_intermediates(
-      self, rows: jnp.ndarray, train: bool = False
+      self, rows: jnp.ndarray, train: bool = False,
+      window_lengths: Optional[jnp.ndarray] = None
   ) -> Dict[str, jnp.ndarray]:
     p = self.params
     deterministic = not train
     if rows.ndim == 4:
       rows = jnp.squeeze(rows, -1)
+    if window_lengths is not None and not train:
+      return self._ragged_forward_with_intermediates(rows, window_lengths)
     if self._fused_hotpath_eligible(rows, train):
       x = self._fused_forward(rows)
       x = self._fused_encoder_blocks(x)

@@ -139,7 +139,16 @@ def span_overlap(events: List[Dict[str, Any]]) -> Dict[str, Any]:
   for key, ts in compute_ts.items():
     fin = finalize_ts.get(key)
     if fin is None:
-      continue  # pack failed before finalize; not a launch sample
+      # Drain-free pack: a fully device-resident run batches its drain
+      # at end-of-input, so the pack has a device_compute span but no
+      # finalize_drain span of its own. Its launch was necessarily
+      # overlapped — a direct launch only ever happens INSIDE finalize
+      # (runner._finalize_sync), which would have emitted the span.
+      # Dropping these from the sample (the old behavior) skewed the
+      # span-derived fraction low on exactly the runs that overlap
+      # best.
+      n_overlapped += 1
+      continue
     if ts < fin:
       n_overlapped += 1
     else:
@@ -151,6 +160,59 @@ def span_overlap(events: List[Dict[str, Any]]) -> Dict[str, Any]:
       'n_direct': n_direct,
       'span_overlap_fraction': (
           round(n_overlapped / launches, 4) if launches else 0.0),
+  }
+
+
+def device_gaps(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+  """Host gaps between consecutive device_compute spans, per pid.
+
+  The device-residency signal for the pack loop: in a fully resident
+  run (weights pinned, donated pack buffers cycling device-side) the
+  only thing between pack N's compute ending and pack N+1's compute
+  starting is the H2D transfer of a later pack's uint8 planes — so
+  each gap should be covered by h2d_transfer spans. Residual
+  uncovered time (host_gap_s) is host work on the critical path: pack
+  assembly stalls, per-pack weight re-transfer, python overhead.
+  transfer_only_fraction is the covered share of all gap time (1.0
+  when there are no gaps at all)."""
+  compute: Dict[int, List[Tuple[float, float]]] = {}
+  h2d: Dict[int, List[Tuple[float, float]]] = {}
+  for e in _complete_spans(events):
+    name = e.get('name')
+    if name not in (trace_lib.STAGE_DEVICE_COMPUTE, trace_lib.STAGE_H2D):
+      continue
+    pid = int(e.get('pid', 0))
+    ts = float(e['ts'])
+    iv = (ts, ts + float(e.get('dur', 0.0)))
+    (compute if name == trace_lib.STAGE_DEVICE_COMPUTE else h2d
+     ).setdefault(pid, []).append(iv)
+  n_gaps = 0
+  gap_s = 0.0
+  transfer_s = 0.0
+  max_host_gap_s = 0.0
+  for pid, intervals in compute.items():
+    intervals.sort()
+    transfers = h2d.get(pid, [])
+    for (_lo_a, hi_a), (lo_b, _hi_b) in zip(intervals, intervals[1:]):
+      if lo_b <= hi_a:
+        continue  # overlapping/adjacent compute: no host gap at all
+      n_gaps += 1
+      gap = (lo_b - hi_a) / 1e6
+      covered = _union_s([
+          (max(lo, hi_a), min(hi, lo_b))
+          for lo, hi in transfers if hi > hi_a and lo < lo_b])
+      gap_s += gap
+      transfer_s += covered
+      max_host_gap_s = max(max_host_gap_s, gap - covered)
+  host_gap_s = gap_s - transfer_s
+  return {
+      'n_gaps': n_gaps,
+      'gap_s': round(gap_s, 6),
+      'transfer_s': round(transfer_s, 6),
+      'host_gap_s': round(host_gap_s, 6),
+      'max_host_gap_s': round(max_host_gap_s, 6),
+      'transfer_only_fraction': (
+          round(transfer_s / gap_s, 4) if gap_s else 1.0),
   }
 
 
@@ -218,6 +280,7 @@ def summarize(events: List[Dict[str, Any]],
       'critical_path': critical_path,
       'stragglers': stragglers,
       'overlap': span_overlap(events),
+      'device_gaps': device_gaps(events),
       'n_traces': len(trace_groups(events)),
   }
 
@@ -247,6 +310,14 @@ def format_summary(summary: Dict[str, Any]) -> str:
       f'transfer overlap (span-derived): '
       f'{overlap["n_overlapped"]}/{overlap["n_packs"]} packs '
       f'(fraction {overlap["span_overlap_fraction"]})')
+  gaps = summary.get('device_gaps')
+  if gaps:
+    lines.append(
+        f'device gaps: {gaps["n_gaps"]} gaps totalling '
+        f'{gaps["gap_s"]:.4f}s, host (non-transfer) '
+        f'{gaps["host_gap_s"]:.4f}s, transfer-only fraction '
+        f'{gaps["transfer_only_fraction"]} '
+        f'(max host gap {gaps["max_host_gap_s"]:.4f}s)')
   if summary['stragglers']:
     lines.append('straggler packs (slowest decile of device compute):')
     for row in summary['stragglers'][:10]:

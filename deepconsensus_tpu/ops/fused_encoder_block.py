@@ -98,11 +98,15 @@ def _dequant_matmul(x2: Array, values: Array, scale: Optional[Array]) -> Array:
 
 
 def _attention(x, wq, wk, wv, wo, *, num_heads, qscale, attn_win_size,
-               length, softmax_dtype):
+               length, softmax_dtype, mask=None):
   """Banded MHA on a [tile, L, H] f32 block with quant-aware
   projections; mirrors fused_window_attention._attention (same band
   mask, same softmax_dtype lever, same op order). Each w is a
-  (values, scale_row_or_None) pair. Shared with the jnp reference."""
+  (values, scale_row_or_None) pair. mask (ragged slots): a
+  [tile, L, L] bool mask that REPLACES the static band — it already
+  ANDs the band with the lengths-derived same-window/valid tests
+  (ragged_window_attention.ragged_attention_mask). Shared with the
+  jnp reference."""
   tile, _, hidden = x.shape
   head_dim = hidden // num_heads
   x2 = x.reshape(tile * length, hidden)
@@ -114,7 +118,8 @@ def _attention(x, wq, wk, wv, wo, *, num_heads, qscale, attn_win_size,
   q = proj(wq) * qscale
   k = proj(wk)
   v = proj(wv)
-  if attn_win_size is not None:
+  band = mask
+  if band is None and attn_win_size is not None:
     rows = jax.lax.broadcasted_iota(jnp.int32, (tile, length, length), 1)
     cols = jax.lax.broadcasted_iota(jnp.int32, (tile, length, length), 2)
     band = jnp.abs(rows - cols) <= attn_win_size
@@ -124,7 +129,7 @@ def _attention(x, wq, wk, wv, wo, *, num_heads, qscale, attn_win_size,
         q[:, :, h, :], k[:, :, h, :], (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )  # [tile, L, L]
-    if attn_win_size is not None:
+    if band is not None:
       s = jnp.where(band, s, _NEG)
     sd = s.astype(softmax_dtype)
     m = jnp.max(sd, axis=2, keepdims=True)
@@ -151,24 +156,29 @@ def _ffn(x, w_filter, b_filter, w_output, b_output, *, length, hidden):
 
 
 def _block_body(x, attn, ffn, attn_alpha, ffn_alpha, *, num_heads, qscale,
-                attn_win_size, length, hidden, softmax_dtype):
+                attn_win_size, length, hidden, softmax_dtype, mask=None):
   """One encoder block on a [tile, L, H] f32 block: optional attention
   residual, then FFN residual, both ReZero (x + alpha * y)."""
   if attn is not None:
     y = _attention(
         x, *attn, num_heads=num_heads, qscale=qscale,
         attn_win_size=attn_win_size, length=length,
-        softmax_dtype=softmax_dtype,
+        softmax_dtype=softmax_dtype, mask=mask,
     )
     x = x + attn_alpha * y
   y = _ffn(x, *ffn, length=length, hidden=hidden)
   return x + ffn_alpha * y
 
 
-def _kernel(*refs, has_attn, num_heads, qscale, attn_win_size, length,
-            hidden, softmax_dtype):
+def _kernel(*refs, has_attn, has_lengths, num_heads, qscale, attn_win_size,
+            length, hidden, softmax_dtype):
   it = iter(refs)
   x_ref = next(it)
+  mask = None
+  if has_lengths:
+    from deepconsensus_tpu.ops import ragged_window_attention as rwa
+
+    mask = rwa.ragged_attention_mask(next(it)[:], length, attn_win_size)
   attn = attn_alpha = None
   if has_attn:
     attn = tuple((next(it)[:], next(it)[:]) for _ in range(4))
@@ -184,7 +194,7 @@ def _kernel(*refs, has_attn, num_heads, qscale, attn_win_size, length,
   x = _block_body(
       x, attn, ffn, attn_alpha, ffn_alpha, num_heads=num_heads,
       qscale=qscale, attn_win_size=attn_win_size, length=length,
-      hidden=hidden, softmax_dtype=softmax_dtype,
+      hidden=hidden, softmax_dtype=softmax_dtype, mask=mask,
   )
   out_ref[:] = x.astype(out_ref.dtype)
 
@@ -213,12 +223,13 @@ def _alpha_input(a: Array) -> Array:
 
 def _block_call(xp: Array, block: EncoderBlockWeights, *, num_heads,
                 attn_win_size, softmax_dtype, compute_dtype, tile,
-                interpret) -> Array:
+                interpret, lengths: Optional[Array] = None) -> Array:
   """One pallas_call over an already tile-padded [B', L, H] batch."""
   bp, length, hidden = xp.shape
   head_dim = hidden // num_heads
   n_tiles = bp // tile
   has_attn = block.wq is not None
+  has_lengths = has_attn and lengths is not None
 
   inputs = [xp]
   in_specs = [pl.BlockSpec((tile, length, hidden), lambda i: (i, 0, 0),
@@ -236,6 +247,10 @@ def _block_call(xp: Array, block: EncoderBlockWeights, *, num_heads,
     inputs.append(a)
     in_specs.append(spec if spec is not None else full(a))
 
+  if has_lengths:
+    add(jnp.asarray(lengths, jnp.int32),
+        pl.BlockSpec((tile, lengths.shape[1]), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM))
   if has_attn:
     for qw in (block.wq, block.wk, block.wv, block.wo):
       add_weight(qw)
@@ -248,7 +263,8 @@ def _block_call(xp: Array, block: EncoderBlockWeights, *, num_heads,
 
   return pl.pallas_call(
       functools.partial(
-          _kernel, has_attn=has_attn, num_heads=num_heads,
+          _kernel, has_attn=has_attn, has_lengths=has_lengths,
+          num_heads=num_heads,
           qscale=head_dim ** -0.5, attn_win_size=attn_win_size,
           length=length, hidden=hidden,
           softmax_dtype=jnp.dtype(softmax_dtype),
@@ -272,12 +288,13 @@ def fused_encoder_block(
     compute_dtype: Any = jnp.float32,
     tile_windows: Optional[int] = None,
     interpret: Optional[bool] = None,
+    lengths: Optional[Array] = None,
 ) -> Array:
   """One fused encoder block over a [B, L, H] window batch."""
   return fused_encoder_stack(
       x, [block], num_heads=num_heads, attn_win_size=attn_win_size,
       softmax_dtype=softmax_dtype, compute_dtype=compute_dtype,
-      tile_windows=tile_windows, interpret=interpret,
+      tile_windows=tile_windows, interpret=interpret, lengths=lengths,
   )
 
 
@@ -291,6 +308,7 @@ def fused_encoder_stack(
     compute_dtype: Any = jnp.float32,
     tile_windows: Optional[int] = None,
     interpret: Optional[bool] = None,
+    lengths: Optional[Array] = None,
 ) -> Array:
   """Run a sequence of fused encoder blocks over a [B, L, H] batch.
 
@@ -300,6 +318,11 @@ def fused_encoder_stack(
   compute_dtype. The final output LayerNorm stays outside — it is the
   caller's (cheap, dtype-sensitive) op, matching the PR-5 split where
   checkpointed scalars live with their parameters.
+
+  lengths (ragged slots): a [B, wps] int32 per-slot window-widths
+  vector; every attention block then masks with the lengths-derived
+  ragged mask (band AND same-window AND valid) instead of the static
+  band alone. FFN/residual halves are position-wise and unaffected.
   """
   from deepconsensus_tpu.ops import pallas_util
 
@@ -312,14 +335,20 @@ def fused_encoder_stack(
   # dclint: allow=dtype-downcast (activations enter the fused stack at
   # the configured compute dtype; accumulation stays f32 in-kernel)
   xp = jnp.asarray(x, compute_dtype)
+  lp = None
+  if lengths is not None:
+    lp = jnp.asarray(lengths, jnp.int32)
   if pad:
     xp = jnp.pad(xp, ((0, pad), (0, 0), (0, 0)))
+    if lp is not None:
+      # Zero lengths: every position of a padded slot is masked invalid.
+      lp = jnp.pad(lp, ((0, pad), (0, 0)))
   interpret = pallas_util.resolve_interpret(interpret)
   for block in blocks:
     xp = _block_call(
         xp, block, num_heads=num_heads, attn_win_size=attn_win_size,
         softmax_dtype=softmax_dtype, compute_dtype=compute_dtype,
-        tile=tile, interpret=interpret,
+        tile=tile, interpret=interpret, lengths=lp,
     )
   return xp[:b]
 
@@ -339,15 +368,22 @@ def reference_encoder_block(
     num_heads: int,
     attn_win_size: Optional[int],
     softmax_dtype: Any = jnp.float32,
+    lengths: Optional[Array] = None,
 ) -> Array:
   """Pure-jnp semantics of one fused block (same helpers, no Pallas):
   the per-block parity oracle for unit tests."""
   _, length, hidden = x.shape
   head_dim = hidden // num_heads
   attn = None
+  mask = None
   if block.wq is not None:
     attn = tuple(_reference_pair(w)
                  for w in (block.wq, block.wk, block.wv, block.wo))
+    if lengths is not None:
+      from deepconsensus_tpu.ops import ragged_window_attention as rwa
+
+      mask = rwa.ragged_attention_mask(
+          jnp.asarray(lengths, jnp.int32), length, attn_win_size)
   ffn = (
       _reference_pair(block.w_filter), _bias_input(block.b_filter),
       _reference_pair(block.w_output), _bias_input(block.b_output),
@@ -359,7 +395,7 @@ def reference_encoder_block(
       jnp.asarray(block.ffn_alpha, jnp.float32),
       num_heads=num_heads, qscale=head_dim ** -0.5,
       attn_win_size=attn_win_size, length=length, hidden=hidden,
-      softmax_dtype=jnp.dtype(softmax_dtype),
+      softmax_dtype=jnp.dtype(softmax_dtype), mask=mask,
   )
 
 
@@ -370,12 +406,13 @@ def reference_encoder_stack(
     num_heads: int,
     attn_win_size: Optional[int],
     softmax_dtype: Any = jnp.float32,
+    lengths: Optional[Array] = None,
 ) -> Array:
   """Pure-jnp mirror of fused_encoder_stack (no pad/tile, f32)."""
   for block in blocks:
     x = reference_encoder_block(
         x, block, num_heads=num_heads, attn_win_size=attn_win_size,
-        softmax_dtype=softmax_dtype,
+        softmax_dtype=softmax_dtype, lengths=lengths,
     )
   return x
 

@@ -199,9 +199,22 @@ class ConsensusService:
     compilation cache this is a cache hit, not a compile)."""
     params = self.engine.params
     t0 = time.monotonic()
-    for width in self.engine.window_buckets:
-      self.engine.runner.predict(np.zeros(
-          (1, params.total_rows, width, 1), dtype=np.float32))
+    if getattr(self.options, 'use_ragged_kernel', False):
+      # Single-pack-stream dispatch: ONE ragged forward shape serves
+      # every bucket width, so warmup is one compile, not one per
+      # bucket (lengths are traced as data, not shape).
+      packer = self.engine._packer_for(max(self.engine.window_buckets))
+      pack = np.zeros(
+          (packer.n_slots, params.total_rows, packer.slot_len, 1),
+          dtype=np.float32)
+      lengths = np.zeros(
+          (packer.n_slots, packer.windows_per_slot), dtype=np.int32)
+      self.engine.runner.finalize(
+          self.engine.runner.dispatch_ragged(pack, lengths))
+    else:
+      for width in self.engine.window_buckets:
+        self.engine.runner.predict(np.zeros(
+            (1, params.total_rows, width, 1), dtype=np.float32))
     self._warm = True
     return time.monotonic() - t0
 
@@ -637,6 +650,13 @@ class ConsensusService:
     counters.setdefault('n_packs_by_bucket', {})
     counters.setdefault('n_forward_shapes', 0)
     counters.setdefault('padding_fraction', 0.0)
+    # Starvation-flush cost (--bucket_flush_packs) and the ragged
+    # single-stream gate (--use_ragged_kernel): real values ride in
+    # from engine.stats() the same way. flush_padding_fraction is
+    # structurally 0.0 on the ragged path (no starvation flush).
+    counters.setdefault('n_starvation_flushes', 0)
+    counters.setdefault('flush_padding_fraction', 0.0)
+    counters.setdefault('use_ragged_kernel', 0)
     with self._lock:
       outstanding = len(self._outstanding)
     engine_stats = self.engine.stats()

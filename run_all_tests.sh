@@ -37,6 +37,12 @@
 #                                  # serve/export (the fast tier also
 #                                  # runs its single-device identity
 #                                  # subset as an explicit gate)
+#   ./run_all_tests.sh ragged      # single-pack-stream ragged dispatch
+#                                  # only: kernel interpret parity at
+#                                  # every bucket width, slot geometry,
+#                                  # mixed-stream byte identity vs the
+#                                  # per-bucket fleet at dp {1,8}, and
+#                                  # the trace-span residency gates
 #
 # Two-tier structure: the `slow` marker covers the heavy interpret-mode
 # Pallas golden sweeps (wavefront train/VJP/unroll, banded-attention
@@ -101,6 +107,11 @@ fi
 if [[ "${1:-}" == "epilogue" ]]; then
   exec python -m pytest \
     tests/test_output_plane.py tests/test_device_epilogue.py -q
+fi
+
+if [[ "${1:-}" == "ragged" ]]; then
+  exec python -m pytest \
+    tests/test_ragged_kernel.py tests/test_ragged_engine.py -q
 fi
 
 # Static analysis first: dclint runs in under a second and fails fast

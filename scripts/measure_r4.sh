@@ -111,6 +111,25 @@ run_stage forward_epilogue 600 \
 run_stage forward_bucketed 900 \
   python "$REPO/scripts/bench_bucketed.py" --batch 1024 --windows 4096 \
   --fused
+# Single ragged pack stream (round-13 beat-or-retire): the same mixed
+# L={100,200} stream, per-bucket packer fleet vs use_ragged_kernel
+# (one compiled forward for the whole run). Reads: speedup_ragged
+# (decision rule in docs/performance.md: >= 1.15x windows/s on the
+# mixed stream keeps ragged as the mixed-width default, else it
+# retires to opt-in), padding_reduction (slot packing should beat
+# per-bucket pad rows), and forward_shapes_collapsed (must end at 1).
+# Exit 1 = delivery byte-identity violation or a second compiled
+# shape — investigate before reading the perf numbers.
+run_stage forward_ragged 900 \
+  python "$REPO/scripts/bench_ragged.py" --batch 1024 --windows 4096
+# Residency read of the same A/B at depth 4: with more packs in
+# flight the host-gap-per-pack number from the trace spans is the
+# signal — a device-resident pack loop leaves compute gaps that are
+# transfer-covered (transfer_only_fraction -> 1.0), so host time per
+# pack should shrink vs the depth-2 forward_ragged stage, not grow.
+run_stage forward_ragged_resident 900 \
+  python "$REPO/scripts/bench_ragged.py" --batch 1024 --windows 4096 \
+  --depth 4
 # dp-sharded double-buffered dispatch (round-6 tentpole): real-chip dp
 # scaling of windows/s + transfer-overlap fraction. Staged to fire on
 # first live tunnel; until then the host-platform parity sweep lives

@@ -304,6 +304,71 @@ def test_bucket_starvation_flush(params):
   assert engine.n_packs == 3
 
 
+def test_starvation_flush_counters_and_fraction(params):
+  """Satellite of the ragged-kernel PR: starvation flushes get their
+  own counters — how often a stranded tail was force-cut and what
+  position fraction of all dispatched capacity those flushes padded —
+  so operators can see the cost the single-pack-stream path removes."""
+  rng = np.random.default_rng(27)
+  engine, delivered, _ = _bucketed_engine(params, flush_packs=2)
+  engine.submit([_win(params, 200, rng)], ['tail'])
+  for group in ('a', 'b'):
+    engine.submit([_win(params, 100, rng) for _ in range(BATCH)],
+                  [(group, i) for i in range(BATCH)])
+  # The 200-tail was starvation-flushed after the second 100-pack.
+  assert engine.n_starvation_flushes == 1
+  stats = engine.stats()
+  assert stats['n_starvation_flushes'] == 1
+  # Flush-padded positions / dispatched position capacity:
+  # (BATCH-1)*200 over (2 packs * BATCH * 100 + 1 pack * BATCH * 200).
+  expect = ((BATCH - 1) * 200) / (2 * BATCH * 100 + BATCH * 200)
+  assert stats['flush_padding_fraction'] == pytest.approx(expect,
+                                                          abs=1e-4)
+  engine.flush()
+  assert delivered['tail'][0].shape == (200,)
+
+
+def test_starvation_flush_pads_counted_once(params):
+  """Regression: a bucket whose FINAL pack was a starvation flush must
+  not double-count its pad rows — the flush attributes them once, and
+  the end-of-input flush() (buffered == 0 after the cut) cannot re-pad
+  the same tail. n_pad_rows stays exactly batch - k."""
+  rng = np.random.default_rng(28)
+  engine, delivered, _ = _bucketed_engine(params, flush_packs=2)
+  engine.submit([_win(params, 200, rng)], ['tail'])
+  for group in ('a', 'b'):
+    engine.submit([_win(params, 100, rng) for _ in range(BATCH)],
+                  [(group, i) for i in range(BATCH)])
+  assert engine.n_pad_rows == BATCH - 1
+  before = engine.stats()['flush_padding_fraction']
+  engine.flush()
+  # No window entered the 200 bucket after its starvation flush: the
+  # end-of-input flush adds no pack, no pad rows, no fraction drift —
+  # the flush-cut tail (buffered == 0 after the cut) is not re-padded.
+  assert engine.n_packs_by_bucket[200] == 1
+  assert engine.n_pad_rows == BATCH - 1
+  assert engine.n_starvation_flushes == 1
+  assert engine.stats()['flush_padding_fraction'] == before
+  assert set(delivered) > {'tail'}
+
+
+def test_end_of_input_flush_is_not_starvation(params):
+  """Ordinary end-of-input tails (both buckets sub-batch at flush())
+  pad the general pool but never the starvation counters."""
+  rng = np.random.default_rng(29)
+  engine, _, _ = _bucketed_engine(params)
+  engine.submit([_win(params, 100, rng) for _ in range(3)]
+                + [_win(params, 200, rng) for _ in range(2)],
+                list(range(5)))
+  engine.flush()
+  assert engine.n_pad_rows == 2 * BATCH - 5
+  assert engine.n_starvation_flushes == 0
+  stats = engine.stats()
+  assert stats['n_starvation_flushes'] == 0
+  assert stats['flush_padding_fraction'] == 0.0
+  assert stats['padding_fraction'] > 0
+
+
 def test_poison_in_one_bucket_leaves_other_bucket_identical(params):
   """Poisoning a ticket whose window lands in the 200-bucket fails only
   that bucket's pack; the 100-bucket's deliveries are byte-identical to

@@ -320,10 +320,89 @@ class TestSummarize:
     assert overlap['n_direct'] == 1
     assert overlap['span_overlap_fraction'] == 0.5
 
-  def test_overlap_skips_unfinalized_pack(self):
+  def test_overlap_counts_drain_free_pack_as_overlapped(self):
+    """Regression: a device_compute span with no finalize_drain span
+    (a drain-free pack — device-resident runs batch their drain at
+    end-of-input) used to be dropped from the sample, skewing the
+    span-derived fraction LOW on exactly the best-overlapped runs. A
+    direct launch only ever happens inside finalize, which would have
+    emitted the span — so drain-free means overlapped."""
     events = [_span('device_compute', 0.0, 1.0, pack=9)]
     overlap = summarize_lib.span_overlap(events)
-    assert overlap['n_packs'] == 0
+    assert overlap['n_packs'] == 1
+    assert overlap['n_overlapped'] == 1
+    assert overlap['n_direct'] == 0
+    assert overlap['span_overlap_fraction'] == 1.0
+
+  def test_overlap_mixed_drained_and_drain_free(self):
+    events = self._pipeline_events() + [
+        _span('device_compute', 4.0, 0.5, pack=2, bucket=100, dp=1,
+              n_rows=64),
+    ]
+    overlap = summarize_lib.span_overlap(events)
+    assert overlap['n_packs'] == 3
+    assert overlap['n_overlapped'] == 2  # pack 1 (early launch) + pack 2
+    assert overlap['n_direct'] == 1
+
+  def test_device_gaps_fully_transfer_covered(self):
+    """Resident pack loop: each inter-compute gap exactly holds the
+    next pack's H2D -> zero host gap, transfer_only_fraction 1.0."""
+    events = []
+    for k in range(3):
+      events.append(_span('h2d_transfer', 1.1 * k + 1.0, 0.1, pack=k))
+      events.append(_span('device_compute', 1.1 * k, 1.0, pack=k))
+    gaps = summarize_lib.device_gaps(events)
+    assert gaps['n_gaps'] == 2
+    assert gaps['gap_s'] == pytest.approx(0.2)
+    assert gaps['transfer_s'] == pytest.approx(0.2)
+    assert gaps['host_gap_s'] == pytest.approx(0.0, abs=1e-9)
+    assert gaps['transfer_only_fraction'] == 1.0
+
+  def test_device_gaps_partial_coverage_is_host_time(self):
+    """Half of a 1s gap covered by H2D: the other half is host work on
+    the critical path (pack assembly, weight re-transfer, python)."""
+    events = [
+        _span('device_compute', 0.0, 1.0, pack=0),
+        _span('h2d_transfer', 1.2, 0.5, pack=1),
+        _span('device_compute', 2.0, 1.0, pack=1),
+    ]
+    gaps = summarize_lib.device_gaps(events)
+    assert gaps['n_gaps'] == 1
+    assert gaps['gap_s'] == pytest.approx(1.0)
+    assert gaps['transfer_s'] == pytest.approx(0.5)
+    assert gaps['host_gap_s'] == pytest.approx(0.5)
+    assert gaps['max_host_gap_s'] == pytest.approx(0.5)
+    assert gaps['transfer_only_fraction'] == pytest.approx(0.5)
+
+  def test_device_gaps_clips_transfers_and_isolates_pids(self):
+    """H2D spans clip to the gap they cover (overlap-running transfers
+    don't inflate coverage), and compute on another pid never pairs."""
+    events = [
+        _span('device_compute', 0.0, 1.0, pack=0),
+        # Transfer starts inside compute and runs past the gap start:
+        # only its in-gap portion counts.
+        _span('h2d_transfer', 0.5, 0.7, pack=1),
+        _span('device_compute', 1.5, 1.0, pack=1),
+        _span('device_compute', 5.0, 1.0, pid=2, pack=0),
+    ]
+    gaps = summarize_lib.device_gaps(events)
+    assert gaps['n_gaps'] == 1
+    assert gaps['gap_s'] == pytest.approx(0.5)
+    assert gaps['transfer_s'] == pytest.approx(0.2)
+    assert gaps['host_gap_s'] == pytest.approx(0.3)
+
+  def test_device_gaps_no_computes(self):
+    gaps = summarize_lib.device_gaps([_span('featurize', 0.0, 1.0)])
+    assert gaps['n_gaps'] == 0
+    assert gaps['gap_s'] == 0.0
+    # No gap time at all = nothing attributable to the host.
+    assert gaps['transfer_only_fraction'] == 1.0
+
+  def test_summary_and_text_include_device_gaps(self):
+    s = summarize_lib.summarize(self._pipeline_events())
+    assert 'device_gaps' in s
+    text = summarize_lib.format_summary(s)
+    assert 'device gaps' in text
 
   def test_stragglers_slowest_decile(self):
     events = [

@@ -1,0 +1,290 @@
+"""Ragged window attention: geometry, kernel parity, model routing.
+
+Three layers of the single-pack-stream contract (ISSUE 17):
+
+  * `slot_geometry` / `ragged_attention_mask` — the lengths-derived
+    geometry both the kernel and the XLA model path share;
+  * the Pallas kernel against `reference_ragged_forward` in interpret
+    mode, at every DEFAULT_WINDOW_BUCKETS width and at an overflow
+    width above FUSED_MAX_WINDOW_LEN;
+  * the model's XLA ragged apply (window_lengths=...) BITWISE against
+    the per-width bucketed applies — this is the path that carries the
+    engine's byte-identity guarantee.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from test_fused_hotpath import make_params, nonzero_alphas
+
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.models import model as model_lib
+from deepconsensus_tpu.ops import fused_window_attention as fwa
+from deepconsensus_tpu.ops import ragged_window_attention as rwa
+
+BUCKETS = config_lib.DEFAULT_WINDOW_BUCKETS
+
+
+def fake_rows_at(params, width, batch, seed):
+  """fake_rows at an arbitrary window width, with the SN rows constant
+  per window across positions (as the real featurizer emits them —
+  the ragged dispatch path extracts one SN scalar per window)."""
+  rng = np.random.default_rng(seed)
+  rows = np.zeros((batch, params.total_rows, width, 1), dtype=np.float32)
+  mp = params.max_passes
+  rows[:, :mp] = rng.integers(0, 5, size=rows[:, :mp].shape)
+  rows[:, mp:2 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 2 * mp:3 * mp] = rng.integers(0, 256, size=rows[:, :mp].shape)
+  rows[:, 3 * mp:4 * mp] = rng.integers(0, 3, size=rows[:, :mp].shape)
+  rows[:, 4 * mp] = rng.integers(0, 5, size=rows[:, 4 * mp].shape)
+  if params.use_ccs_bq:
+    rows[:, 4 * mp + 1] = rng.integers(
+        -1, params.CCS_BQ_MAX - 1, size=rows[:, 4 * mp + 1].shape)
+    sn_lo = 4 * mp + 2
+  else:
+    sn_lo = 4 * mp + 1
+  sn = rng.integers(0, 501, size=(batch, rows.shape[1] - sn_lo, 1, 1))
+  rows[:, sn_lo:] = np.broadcast_to(sn, rows[:, sn_lo:].shape)
+  return rows
+
+
+# ----------------------------------------------------------------------
+# Geometry helpers
+
+
+def test_validate_buckets_accepts_divisibility_chain():
+  assert rwa.validate_ragged_buckets((100, 200)) == (100, 200)
+  assert rwa.validate_ragged_buckets((50, 100, 200)) == (50, 100, 200)
+  assert rwa.windows_per_slot((100, 200)) == 2
+  assert rwa.windows_per_slot((50, 100, 200)) == 4
+
+
+@pytest.mark.parametrize('bad,match', [
+    ((), 'positive'),
+    ((100, 0), 'positive'),
+    ((200, 100), 'ascending'),
+    ((100, 100, 200), 'ascending'),
+    ((100, 150), 'divisibility chain'),
+])
+def test_validate_buckets_rejects(bad, match):
+  with pytest.raises(ValueError, match=match):
+    rwa.validate_ragged_buckets(bad)
+
+
+def test_slot_geometry_mixed_slots():
+  lengths = jnp.asarray([[200, 0], [100, 100], [100, 0]], jnp.int32)
+  seg, start, width, valid = rwa.slot_geometry(lengths, 200)
+  seg, start, width, valid = map(np.asarray, (seg, start, width, valid))
+  # Slot 0: one window spanning all 200 positions.
+  assert (seg[0] == 0).all() and (start[0] == 0).all()
+  assert (width[0] == 200).all() and valid[0].all()
+  # Slot 1: window 0 at [0,100), window 1 at [100,200).
+  assert (seg[1, :100] == 0).all() and (seg[1, 100:] == 1).all()
+  assert (start[1, :100] == 0).all() and (start[1, 100:] == 100).all()
+  assert (width[1] == 100).all() and valid[1].all()
+  # Slot 2: half-filled — tail positions invalid, seg stays 0 there.
+  assert valid[2, :100].all() and not valid[2, 100:].any()
+  assert (seg[2] == 0).all()
+
+
+def test_ragged_attention_mask_is_blockwise_band():
+  lengths = jnp.asarray([[100, 100]], jnp.int32)
+  win = 12
+  mask = np.asarray(rwa.ragged_attention_mask(lengths, 200, win))[0]
+  # No attention across the window seam, in either direction.
+  assert not mask[:100, 100:].any() and not mask[100:, :100].any()
+  # Within a window the mask equals the per-width band: |i-j| <= win.
+  ii, jj = np.meshgrid(np.arange(100), np.arange(100), indexing='ij')
+  band = np.abs(ii - jj) <= win
+  np.testing.assert_array_equal(mask[:100, :100], band)
+  np.testing.assert_array_equal(mask[100:, 100:], band)
+  # Unused capacity attends to nothing and is attended by nothing.
+  half = np.asarray(rwa.ragged_attention_mask(
+      jnp.asarray([[100, 0]], jnp.int32), 200, win))[0]
+  assert not half[100:, :].any() and not half[:, 100:].any()
+
+
+# ----------------------------------------------------------------------
+# Pallas kernel vs jnp reference (interpret mode)
+
+
+@pytest.fixture(scope='module')
+def ragged_setup():
+  params = make_params(pre=dict(window_buckets=BUCKETS))
+  model = model_lib.get_model(params)
+  init_rows = jnp.asarray(fake_rows_at(params, BUCKETS[0], 2, 0))
+  variables = nonzero_alphas(model.init(jax.random.PRNGKey(0), init_rows))
+  specs, keys, _ = fwa.build_family_specs(params)
+  p = variables['params']
+  tables = {k: p[f'{k}_embedding']['embedding'] for k in keys}
+  h = params.hidden_size
+  a0 = p['encoder']['self_attention_0']
+  weights = dict(
+      w_cond=p['condenser']['kernel'],
+      wq=a0['query']['kernel'].reshape(h, h),
+      wk=a0['key']['kernel'].reshape(h, h),
+      wv=a0['value']['kernel'].reshape(h, h),
+      wo=a0['output_transform']['kernel'].reshape(h, h))
+  kwargs = dict(specs=specs, table_keys=keys, num_heads=params.num_heads,
+                attn_win_size=params.attn_win_size or None)
+  return params, model, variables, tables, weights, kwargs
+
+
+def _slots_and_lengths(params, widths_per_slot, slot_len, seed=7):
+  """Build a [n_slots, R, slot_len] pack + lengths from a width plan."""
+  rng_seed = seed
+  n_slots = len(widths_per_slot)
+  wps = max(len(ws) for ws in widths_per_slot)
+  slots = np.zeros((n_slots, params.total_rows, slot_len, 1), np.float32)
+  lengths = np.zeros((n_slots, wps), np.int32)
+  for s, ws in enumerate(widths_per_slot):
+    off = 0
+    for j, w in enumerate(ws):
+      slots[s, :, off:off + w] = fake_rows_at(params, w, 1, rng_seed)[0]
+      lengths[s, j] = w
+      off += w
+      rng_seed += 1
+  return jnp.asarray(np.squeeze(slots, -1)), jnp.asarray(lengths)
+
+
+def _run_pair(setup, widths_per_slot, slot_len):
+  params, _model, _variables, tables, weights, kwargs = setup
+  ids, lengths = _slots_and_lengths(params, widths_per_slot, slot_len)
+  pos = jnp.asarray(model_lib.sinusoidal_position_encoding(
+      slot_len, params.hidden_size))
+  args = (ids, lengths, tables, weights['w_cond'], weights['wq'],
+          weights['wk'], weights['wv'], weights['wo'], pos)
+  ref = rwa.reference_ragged_forward(*args, **kwargs)
+  got = rwa.ragged_embed_condense_attention(*args, **kwargs, interpret=True)
+  return ref, got
+
+
+@pytest.mark.parametrize('width', BUCKETS)
+def test_kernel_interpret_parity_uniform_width(ragged_setup, width):
+  """Slots uniformly packed at one bucket width — the degenerate mix
+  every pure stream produces — must match the reference exactly."""
+  slot_len = BUCKETS[-1]
+  per_slot = slot_len // width
+  (xb_r, at_r), (xb_k, at_k) = _run_pair(
+      ragged_setup, [[width] * per_slot, [width] * per_slot], slot_len)
+  np.testing.assert_allclose(xb_k, xb_r, rtol=0, atol=1e-6)
+  np.testing.assert_allclose(at_k, at_r, rtol=0, atol=1e-6)
+
+
+def test_kernel_interpret_parity_mixed_and_partial(ragged_setup):
+  """The real mixed-stream shapes: a full wide slot, a full pair of
+  narrow windows, and a partial slot with trailing unused capacity."""
+  slot_len = BUCKETS[-1]
+  (xb_r, at_r), (xb_k, at_k) = _run_pair(
+      ragged_setup,
+      [[slot_len], [BUCKETS[0], BUCKETS[0]], [BUCKETS[0]]], slot_len)
+  np.testing.assert_allclose(xb_k, xb_r, rtol=0, atol=1e-6)
+  np.testing.assert_allclose(at_k, at_r, rtol=0, atol=1e-6)
+
+
+def test_kernel_interpret_parity_overflow_width(ragged_setup):
+  """One width above the largest bucket (and FUSED_MAX_WINDOW_LEN):
+  the slot layout doesn't care what widths the engine buckets to, only
+  that slot_len stays under RAGGED_MAX_SLOT_LEN."""
+  assert 256 > BUCKETS[-1]
+  (xb_r, at_r), (xb_k, at_k) = _run_pair(ragged_setup, [[256]], 256)
+  np.testing.assert_allclose(xb_k, xb_r, rtol=0, atol=1e-6)
+  np.testing.assert_allclose(at_k, at_r, rtol=0, atol=1e-6)
+
+
+def test_kernel_rejects_oversized_slot(ragged_setup):
+  params = ragged_setup[0]
+  with pytest.raises(ValueError, match='RAGGED_MAX_SLOT_LEN'):
+    _run_pair(ragged_setup, [[rwa.RAGGED_MAX_SLOT_LEN + 128]],
+              rwa.RAGGED_MAX_SLOT_LEN + 128)
+
+
+def test_ragged_reference_matches_narrow_fused_reference(ragged_setup):
+  """A narrow window computed inside a ragged slot agrees with the
+  bucketed fused reference computing it at its natural width."""
+  params, _model, _variables, tables, weights, kwargs = ragged_setup
+  w = BUCKETS[0]
+  narrow = fake_rows_at(params, w, 2, 31)
+  slot_len = BUCKETS[-1]
+  slots = np.zeros((1, params.total_rows, slot_len), np.float32)
+  slots[0, :, :w] = narrow[0, :, :, 0]
+  slots[0, :, w:2 * w] = narrow[1, :, :, 0]
+  lengths = jnp.asarray([[w, w]], jnp.int32)
+  pos_s = jnp.asarray(model_lib.sinusoidal_position_encoding(
+      slot_len, params.hidden_size))
+  pos_n = jnp.asarray(model_lib.sinusoidal_position_encoding(
+      w, params.hidden_size))
+  _xb_r, at_r = rwa.reference_ragged_forward(
+      jnp.asarray(slots), lengths, tables, weights['w_cond'],
+      weights['wq'], weights['wk'], weights['wv'], weights['wo'],
+      pos_s, **kwargs)
+  _xb_n, at_n = fwa.reference_fused_forward(
+      jnp.asarray(np.squeeze(narrow, -1)), tables, weights['w_cond'],
+      weights['wq'], weights['wk'], weights['wv'], weights['wo'],
+      pos_n, **kwargs)
+  np.testing.assert_allclose(at_r[0, :w], at_n[0], rtol=0, atol=1e-5)
+  np.testing.assert_allclose(at_r[0, w:2 * w], at_n[1], rtol=0, atol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# XLA model routing: ragged apply is BITWISE vs per-width applies
+
+
+def test_model_ragged_apply_bitwise_vs_per_width(ragged_setup):
+  """The byte-identity mechanism: the ragged apply computes each bucket
+  width over the reshaped slots — THE SAME SHAPE as a per-width apply
+  of that reshape — so a plain apply on the reshaped content must agree
+  bit-for-bit at every position the lengths vector owns. (Cross-shape
+  agreement — e.g. vs a standalone batch-of-1 apply — is ~1-ulp, since
+  XLA's CPU tiling varies with batch; the engine's FASTQ byte identity
+  is carried by the shape-matched compute plus uint8 quantization, and
+  asserted end-to-end in test_ragged_engine.py.)"""
+  params, model, variables, *_ = ragged_setup
+  wide, narrow = BUCKETS[-1], BUCKETS[0]
+  per_slot = wide // narrow
+  w_wide = fake_rows_at(params, wide, 1, 7)
+  w_narrow = fake_rows_at(params, narrow, per_slot + 1, 11)
+
+  r = params.total_rows
+  slots = np.zeros((3, r, wide, 1), np.float32)
+  lengths = np.zeros((3, per_slot), np.int32)
+  slots[0] = w_wide[0]
+  lengths[0, 0] = wide
+  for j in range(per_slot):
+    slots[1, :, j * narrow:(j + 1) * narrow] = w_narrow[j]
+    lengths[1, j] = narrow
+  slots[2, :, :narrow] = w_narrow[per_slot]
+  lengths[2, 0] = narrow
+
+  got = np.asarray(model.apply(
+      variables, jnp.asarray(slots), False,
+      window_lengths=jnp.asarray(lengths),
+      method='apply_with_intermediates')['preds'])
+
+  # Per-width references at the ragged path's own reshape batch: the
+  # slots read as 3 wide windows, or (splitting the position axis) as
+  # 6 narrow windows in slot-major order.
+  ref_wide = np.asarray(model.apply(
+      variables, jnp.asarray(slots), False,
+      method='apply_with_intermediates')['preds'])
+  as_narrow = slots.reshape(3, r, per_slot, narrow, 1).transpose(
+      0, 2, 1, 3, 4).reshape(3 * per_slot, r, narrow, 1)
+  ref_narrow = np.asarray(model.apply(
+      variables, jnp.asarray(as_narrow), False,
+      method='apply_with_intermediates')['preds'])
+
+  np.testing.assert_array_equal(got[0, :wide], ref_wide[0])
+  for j in range(per_slot):
+    np.testing.assert_array_equal(
+        got[1, j * narrow:(j + 1) * narrow], ref_narrow[per_slot + j])
+  np.testing.assert_array_equal(
+      got[2, :narrow], ref_narrow[2 * per_slot])
+
+  # Cross-shape (standalone per-window applies): numerically tight but
+  # not bitwise — XLA reassociates tiling across batch shapes.
+  alone = np.asarray(model.apply(
+      variables, jnp.asarray(w_narrow), False,
+      method='apply_with_intermediates')['preds'])
+  np.testing.assert_allclose(got[2, :narrow], alone[per_slot],
+                             rtol=0, atol=1e-5)

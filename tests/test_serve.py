@@ -212,6 +212,11 @@ def test_mixed_width_clients_share_per_bucket_packs(serve_ctx, params):
   counters = m['counters']
   assert set(map(int, counters['n_packs_by_bucket'])) == {100, 200}
   assert counters['padding_fraction'] > 0
+  # Starvation accounting reaches /metricz (values depend on request
+  # interleaving; the math is pinned at the engine boundary).
+  assert counters['n_starvation_flushes'] >= 0
+  assert 0.0 <= counters['flush_padding_fraction'] <= 1.0
+  assert counters['use_ragged_kernel'] == 0
   assert m['window_buckets'] == [100, 200]
   # A width outside the buckets is a 400, not an engine fault.
   with pytest.raises(ServeClientError, match='400'):

@@ -106,6 +106,18 @@ def test_tail_flush_pads_final_pack(tmp_path, synthetic_bams, params):
     assert qual == chr(STUB_QUAL + 33) * SEQ_LEN
 
 
+def test_sidecar_reports_starvation_counters(tmp_path, synthetic_bams,
+                                             params):
+  """run_inference copies the engine's starvation accounting into the
+  counters sidecar: fixed-width streams never starve, so both keys are
+  present at their zero values (the live values are exercised at the
+  engine boundary in test_engine.py)."""
+  _out, counters, _ccs = _run(tmp_path, synthetic_bams, params,
+                              'starve_keys', batch_size=8)
+  assert counters['n_starvation_flushes'] == 0
+  assert counters['flush_padding_fraction'] == 0.0
+
+
 def test_molecules_span_pack_boundaries(tmp_path, synthetic_bams, params):
   """batch_size < windows-per-molecule: every molecule's windows land
   in different packs (and different featurize batches' packs) and must

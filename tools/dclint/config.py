@@ -113,8 +113,8 @@ HOT_FUNCTIONS = {
         'flush', 'submit', 'submit_formatted',
     }),
     'deepconsensus_tpu/inference/runner.py': frozenset({
-        'dispatch', 'finalize', '_finalize_sync', 'predict', '_launch',
-        '_launch_pending', 'raw_outputs',
+        'dispatch', 'dispatch_ragged', 'finalize', '_finalize_sync',
+        'predict', '_launch', '_launch_pending', 'raw_outputs',
     }),
     'deepconsensus_tpu/serve/service.py': frozenset({
         '_model_loop', '_ingest', '_deliver', '_process_retries',
@@ -132,7 +132,8 @@ HOT_FUNCTIONS = {
 # the target a device value for host-sync tracking.  Matched on the
 # last dotted segment.
 DEVICE_SOURCE_CALLS = frozenset({
-    '_jit_forward', 'device_put', 'dispatch',
+    '_jit_forward', '_jit_ragged_forward', 'device_put', 'dispatch',
+    'dispatch_ragged',
     # Output-plane epilogues (ops/output_plane.py): their uint8 planes
     # are device values until the finalize drain.
     'phred_epilogue', 'phred_epilogue_pallas',
@@ -159,7 +160,8 @@ HOST_SYNC_CALLS = frozenset({'float', 'int', 'bool', 'asarray', 'array'})
 # double-buffered `device_put` transfer.  A host-materialising use of a
 # transfer result BEFORE this call is an implicit sync that defeats the
 # transfer/compute overlap (jit-hazards double-buffer rule).
-FORWARD_CALLS = frozenset({'_forward', 'phred_epilogue',
+FORWARD_CALLS = frozenset({'_forward', '_ragged_forward',
+                           'ragged_forward', 'phred_epilogue',
                            'phred_epilogue_pallas', 'train_step'})
 
 # dtype-downcast sub-rule: modules where an unannotated cast to a
