@@ -526,6 +526,37 @@ def _add_train(sub):
                  help='Total number of hosts (multi-host training).')
   p.add_argument('--process_id', type=int,
                  help='This host\'s index (multi-host training).')
+  p.add_argument('--elastic', action='store_true',
+                 help='Elastic multi-host mode: every cross-host '
+                 'collective is a bounded barrier over a shared '
+                 'filesystem under <out_dir>/.pod, a lost host '
+                 'triggers a coordinated pod rebuild instead of a '
+                 'hang, and a recovered host is re-admitted at the '
+                 'next step boundary. Uses --process_id/'
+                 '--num_processes for membership; jax.distributed is '
+                 'NOT initialized (the pod owns cross-host transport).')
+  p.add_argument('--on_host_error', default='degrade',
+                 choices=['fail', 'degrade'],
+                 help='Elastic policy when a barrier times out on a '
+                 'missing host: fail propagates HostLostError (the '
+                 'retry wrapper restarts from the last checkpoint), '
+                 'degrade rebuilds the pod over the surviving hosts, '
+                 're-places the live state, and resumes from the '
+                 'failed step (default).')
+  p.add_argument('--elastic_barrier_timeout', type=float, default=30.0,
+                 help='Deadline in seconds for every elastic '
+                 'collective (step sync, checkpoint barrier, '
+                 'stop-vote). On expiry the missing host is named in '
+                 'a typed HostLostError; no collective waits '
+                 'unbounded (default 30).')
+  p.add_argument('--elastic_readmit', dest='elastic_readmit',
+                 action='store_true', default=True,
+                 help='Allow a recovered host to rejoin the pod at a '
+                 'step boundary (default on).')
+  p.add_argument('--no_elastic_readmit', dest='elastic_readmit',
+                 action='store_false',
+                 help='Refuse re-admission; a lost host stays lost '
+                 'until the run restarts.')
 
 
 def _add_evaluate(sub):
@@ -1204,8 +1235,23 @@ def _dispatch(args) -> int:
       if args.on_shard_error:
         params.on_shard_error = args.on_shard_error
       params.on_device_error = args.on_device_error
-    if (args.coordinator_address or args.num_processes
-        or args.process_id is not None):
+      params.on_host_error = args.on_host_error
+      params.elastic_barrier_timeout = args.elastic_barrier_timeout
+      params.tp = args.tp  # local_mesh size in elastic mode
+    elastic_config = None
+    if args.elastic:
+      # The pod owns cross-host transport (bounded file barriers under
+      # <out_dir>/.pod); jax.distributed must NOT be initialized or its
+      # unbounded collectives would race the pod's membership protocol.
+      elastic_config = {
+          'host_id': args.process_id or 0,
+          'n_hosts': args.num_processes or 1,
+          'barrier_timeout': args.elastic_barrier_timeout,
+          'on_host_error': args.on_host_error,
+          'readmit': args.elastic_readmit,
+      }
+    elif (args.coordinator_address or args.num_processes
+          or args.process_id is not None):
       # Initialize before the mesh is built so it spans all hosts
       # (run_training's own distributed_config hook is for programmatic
       # callers; the CLI must init before make_mesh below).
@@ -1216,7 +1262,12 @@ def _dispatch(args) -> int:
           num_processes=args.num_processes,
           process_id=args.process_id,
       )
-    if args.dp:
+    if elastic_config is not None:
+      # Each elastic host runs a LOCAL mesh over its own devices;
+      # run_training builds it (mesh_lib.local_mesh) so state
+      # re-placement after a rebuild stays host-local.
+      mesh = None
+    elif args.dp:
       import jax
 
       mesh = mesh_lib.make_mesh(
@@ -1232,6 +1283,7 @@ def _dispatch(args) -> int:
         num_epochs=args.num_epochs,
         mesh=mesh,
         warm_start=args.checkpoint,
+        elastic_config=elastic_config,
     )
     return 0
 

@@ -297,6 +297,57 @@ class DispatchTimeoutError(DeviceFault):
     super().__init__(detail)
 
 
+class HostLostError(RuntimeError):
+  """A bounded pod barrier expired: one or more member hosts never
+  posted their payload within the deadline (`--elastic_barrier_timeout`),
+  or a watchdog-wrapped legacy collective (the PreemptionGuard stop
+  vote, orbax's multihost save) missed its deadline. Carries the
+  missing process indices so the rebuild path logs WHO was lost, the
+  barrier name, and the pod epoch the barrier ran under.
+
+  Transient by construction (UNAVAILABLE marker): with
+  `--on_host_error=degrade` the survivors run the agreement round and
+  rebuild; with `--on_host_error=fail` the retry loop restarts the
+  process, which re-forms the pod from the surviving heartbeats."""
+
+  def __init__(self, detail: str, *, missing: Any = (),
+               barrier: str = '', epoch: Optional[int] = None):
+    self.missing = tuple(int(m) for m in missing)
+    self.barrier = barrier
+    self.epoch = epoch
+    parts = [f'UNAVAILABLE: {detail}']
+    if self.missing:
+      parts.append(f'missing host(s) {list(self.missing)}')
+    if barrier:
+      parts.append(f'barrier={barrier!r}')
+    if epoch is not None:
+      parts.append(f'pod_epoch={epoch}')
+    super().__init__('; '.join(parts))
+
+  @property
+  def kind(self) -> str:
+    return classify_error(str(self))
+
+
+class ElasticRebuildError(RuntimeError):
+  """The pod-wide agreement round could not converge on a consistent
+  member set (survivor proposals never intersected to a stable quorum
+  within the retry budget, or this host was voted out of the pod).
+  Permanent by construction: no transient markers, so the retry loop
+  re-raises instead of looping on a pod that cannot re-form — the
+  operator must restart the lost hosts or the whole pod."""
+
+
+class InjectedHostDeath(RuntimeError):
+  """Raised by the ENV_HOST_LOST_AT_STEP hook in `drop` mode: the
+  in-process analog of a SIGKILLed host for threaded drills — the
+  host's pod endpoint is abandoned (heartbeats stop, no tombstone)
+  and its training loop unwinds, leaving exactly the wreckage a real
+  host death leaves: a stale heartbeat and a missed barrier. Permanent
+  for the dying host itself (it must not retry); survivors never see
+  this type — they see the HostLostError their next barrier raises."""
+
+
 # Message signatures of a halted/lost device, as surfaced by the XLA
 # CPU/TPU runtimes.
 _DEVICE_LOST_MARKERS = (
@@ -450,6 +501,22 @@ ENV_DEVICE_LOST_AT_STEP = 'DCTPU_FAULT_DEVICE_LOST_AT_STEP'
 # process exits cleanly, exactly as if SIGUSR1 had arrived from the
 # cloud provider's preemption agent. Fractional seconds allowed.
 ENV_PREEMPT_AT_S = 'DCTPU_FAULT_PREEMPT_AT_S'
+# Elastic-pod host hooks (`inject_faults.py host`). HOST_LOST_AT_STEP
+# targets a 1-based training step: at that step the targeted host dies
+# (consume-once). HOST_LOST_HOST scopes the hook to one pod host id
+# (default: whichever host reaches the step first and claims the
+# ENV_KILL_TOKEN). HOST_LOST_MODE picks the death style: `kill`
+# (default) SIGKILLs the process — the real drill for subprocess pods;
+# `drop` abandons the host's pod endpoint in-process and raises
+# InjectedHostDeath — the threaded-drill analog, leaving the same
+# wreckage (stale heartbeat, missed barrier) without taking the test
+# runner down. HOST_REJOIN_AT_STEP arms the *restarted* host: it defers
+# its re-admission announcement until the pod's observed step reaches
+# the target, so rejoin drills land at a deterministic step boundary.
+ENV_HOST_LOST_AT_STEP = 'DCTPU_FAULT_HOST_LOST_AT_STEP'
+ENV_HOST_LOST_HOST = 'DCTPU_FAULT_HOST_LOST_HOST'
+ENV_HOST_LOST_MODE = 'DCTPU_FAULT_HOST_LOST_MODE'
+ENV_HOST_REJOIN_AT_STEP = 'DCTPU_FAULT_HOST_REJOIN_AT_STEP'
 
 # Hooks that already fired in this process (consume-once semantics:
 # after a NaN-sentinel rollback the training loop passes the same step
@@ -612,3 +679,49 @@ def maybe_kill_shard_reader(shard_path: str) -> None:
   import signal
 
   os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_host_lost(step: int, host_id: int,
+                    abandon: Optional[Any] = None) -> None:
+  """Kills the targeted pod host at the target training step (1-based,
+  consume-once). ENV_HOST_LOST_HOST scopes the hook to one host id —
+  checked BEFORE consuming, so the hook stays armed in processes it
+  doesn't target. Mode `kill` (default) SIGKILLs, honoring
+  ENV_KILL_TOKEN across restarts; mode `drop` calls `abandon()` (the
+  host's `ElasticPod.abandon`) and raises InjectedHostDeath for
+  in-process threaded drills."""
+  scoped = os.environ.get(ENV_HOST_LOST_HOST, '')
+  if scoped and int(scoped) != host_id:
+    return
+  mode = os.environ.get(ENV_HOST_LOST_MODE, 'kill')
+  if mode == 'drop':
+    if not _fire_once(ENV_HOST_LOST_AT_STEP, step):
+      return
+    log.warning('fault injection: dropping pod host %d at step %d',
+                host_id, step)
+    if abandon is not None:
+      abandon()
+    raise InjectedHostDeath(
+        f'injected host death: host {host_id} dropped at step {step}')
+  if _env_int(ENV_HOST_LOST_AT_STEP) != step:
+    return
+  if not _claim_token():
+    return
+  import signal
+
+  log.warning('fault injection: SIGKILL pod host %d at step %d',
+              host_id, step)
+  os.kill(os.getpid(), signal.SIGKILL)
+
+
+def host_rejoin_step() -> int:
+  """1-based pod step before which a restarted host should defer its
+  re-admission announcement (0 = hook unarmed). Consume-once: after the
+  deferred join lands, later pod restarts in the same process announce
+  immediately."""
+  if ENV_HOST_REJOIN_AT_STEP in _fired:
+    return 0
+  target = _env_int(ENV_HOST_REJOIN_AT_STEP)
+  if target > 0:
+    _fired.add(ENV_HOST_REJOIN_AT_STEP)
+  return target

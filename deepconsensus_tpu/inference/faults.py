@@ -47,6 +47,10 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ENV_DEVICE_LOST_AT_PACK,
     ENV_DEVICE_LOST_AT_STEP,
     ENV_DEVICE_OOM_AT_PACK,
+    ENV_HOST_LOST_AT_STEP,
+    ENV_HOST_LOST_HOST,
+    ENV_HOST_LOST_MODE,
+    ENV_HOST_REJOIN_AT_STEP,
     ENV_KILL_SHARD_READER,
     ENV_KILL_TOKEN,
     ENV_KILL_TRAIN_AT_STEP,
@@ -70,10 +74,13 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     DeviceOomError,
     DispatchTimeoutError,
     DrainingError,
+    ElasticRebuildError,
     ExportedArtifactMismatchError,
     FaultKind,
     FleetRejection,
     FlywheelGateError,
+    HostLostError,
+    InjectedHostDeath,
     NonFiniteTrainingError,
     QuotaExceededError,
     ReplicaLostError,
@@ -81,10 +88,12 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ServeRejection,
     classify_device_error,
     classify_error,
+    host_rejoin_step,
     injected_crash_after_batches,
     injected_device_fault,
     injected_device_hang,
     injected_train_device_fault,
+    maybe_host_lost,
     maybe_kill_shard_reader,
     maybe_kill_train_at_step,
     maybe_kill_worker,

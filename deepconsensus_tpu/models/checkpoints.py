@@ -149,10 +149,14 @@ def _file_inventory(ckpt_path: str) -> Dict[str, int]:
 
 
 def write_manifest(ckpt_path: str, step: int,
-                   digest: Optional[str] = None) -> str:
+                   digest: Optional[str] = None,
+                   extra: Optional[Dict[str, Any]] = None) -> str:
   """Commits the manifest for a fully-written checkpoint (atomic write
   + rename). Call only after the checkpointer's wait_until_finished:
-  the manifest's existence IS the commit record."""
+  the manifest's existence IS the commit record. `extra` merges
+  additional provenance keys (elastic runs record pod_epoch and
+  pod_members so a checkpoint names the member set that wrote it);
+  reserved keys cannot be overridden."""
   path = manifest_path(ckpt_path)
   os.makedirs(os.path.dirname(path), exist_ok=True)
   manifest = {
@@ -162,6 +166,9 @@ def write_manifest(ckpt_path: str, step: int,
       'time': time.time(),
       'files': _file_inventory(ckpt_path),
   }
+  if extra:
+    for key, value in extra.items():
+      manifest.setdefault(key, value)
   tmp = path + '.tmp'
   with open(tmp, 'w') as f:
     json.dump(manifest, f)

@@ -357,6 +357,13 @@ class StreamingDataset:
   # 'skip': log + count it and move on to the next shard — a single
   # corrupt shard out of thousands must not kill a multi-day run.
   on_shard_error: str = OnShardError.FAIL
+  # Per-host shard assignment for pod-scale streaming: host `host_rank`
+  # of `host_count` reads every host_count-th shard (round-robin over
+  # the sorted glob). Default (0 of 1) reads everything — the
+  # identical-batches mode the elastic identity drills rely on. An
+  # elastic rebuild retargets the assignment via reassign_hosts().
+  host_rank: int = 0
+  host_count: int = 1
 
   def __post_init__(self):
     from deepconsensus_tpu.io.tfrecord import glob_paths
@@ -367,16 +374,53 @@ class StreamingDataset:
           f'on_shard_error must be one of {OnShardError.CHOICES}, '
           f'got {self.on_shard_error!r}'
       )
-    self._paths = glob_paths(self.patterns)
-    if not self._paths:
+    if not 0 <= self.host_rank < max(self.host_count, 1):
+      # dclint: allow=typed-faults (flag validation at startup)
+      raise ValueError(
+          f'host_rank={self.host_rank} out of range for '
+          f'host_count={self.host_count}'
+      )
+    self._all_paths = glob_paths(self.patterns)
+    if not self._all_paths:
       # dclint: allow=typed-faults (startup config error: the operator
       # pointed the loader at an empty glob)
       raise ValueError(f'no shards matched {self.patterns!r}')
+    self._paths = self._assigned_paths(self.host_rank, self.host_count)
     self._rng = np.random.default_rng(self.seed)
     self._with_name = bool(self.params.get('track_window_ids', False))
     # Fault counters (n_shard_errors, ...) survive the iterator so the
     # training driver can report them at end of run.
     self.counters: collections.Counter = collections.Counter()
+
+  def _assigned_paths(self, rank: int, count: int) -> list:
+    """Round-robin shard assignment for one host. A host whose slot is
+    empty (more hosts than shards) falls back to the full set — reading
+    duplicate data beats deadlocking an admitted member with no
+    input."""
+    assigned = self._all_paths[rank::max(count, 1)]
+    if not assigned:
+      log.warning(
+          'host %d/%d has no shards under round-robin assignment of '
+          '%d path(s); falling back to the full shard set',
+          rank, count, len(self._all_paths))
+      return list(self._all_paths)
+    return assigned
+
+  def reassign_hosts(self, rank: int, count: int) -> None:
+    """Retargets the per-host shard assignment after an elastic
+    membership change (rebuild shrinks host_count, re-admission grows
+    it back). Takes effect at the next epoch's shard permutation — the
+    shard currently being read finishes under the old assignment. The
+    swap is a single reference assignment, so the reader thread sees
+    either the old or the new list, never a mix."""
+    if (rank, count) == (self.host_rank, self.host_count):
+      return
+    self.host_rank, self.host_count = int(rank), int(count)
+    self._paths = self._assigned_paths(self.host_rank, self.host_count)
+    self.counters['n_shard_reassignments'] += 1
+    log.warning('streaming shards reassigned: host %d/%d now owns %d '
+                'of %d shard(s)', rank, count, len(self._paths),
+                len(self._all_paths))
 
   def _raw_stream(self) -> Iterator[bytes]:
     """Shards in a fresh random order each epoch, consumed ONE AT A
@@ -390,8 +434,12 @@ class StreamingDataset:
 
     while True:
       produced = False
-      for i in self._rng.permutation(len(self._paths)):
-        path = self._paths[i]
+      # Snapshot the assignment for this epoch: reassign_hosts swaps
+      # self._paths from the training thread, and indexing a shrunk
+      # list with a stale permutation would walk off the end.
+      paths = self._paths
+      for i in self._rng.permutation(len(paths)):
+        path = paths[i]
         try:
           for raw in TFRecordReader(path, native_decode=True):
             produced = True

@@ -158,6 +158,16 @@ class Trainer:
   params: ml_collections.ConfigDict
   out_dir: str
   mesh: Optional[Any] = None
+  # Elastic pod membership endpoint (parallel/elastic.py). When set,
+  # the mesh is host-local, cross-host reduction runs through the pod's
+  # bounded step_sync, and "the one writer" means the pod LEADER (lowest
+  # live host id — survives leader loss) rather than jax process 0.
+  pod: Optional[Any] = None
+  # False when each pod member streams its OWN shard subset
+  # (elastic_config['shard_streams']): batches are then host-local data,
+  # not slices of a replicated global batch, so localize_batch must not
+  # re-slice them.
+  pod_slices_batches: bool = True
 
   def __post_init__(self):
     # Training fixes ONE window shape: the jitted step compiles for a
@@ -251,6 +261,24 @@ class Trainer:
     single source train/eval/distill pjit steps compile against."""
     return partition_rules.tree_shardings(self.mesh, state)
 
+  def _is_writer(self) -> bool:
+    """Whether THIS host owns the shared-filesystem mutations
+    (checkpoint manifests, TSV/best sidecars, metrics.jsonl,
+    quarantine). Elastic pods elect the leader; legacy multi-host keeps
+    the fixed process-0 convention."""
+    if self.pod is not None:
+      return self.pod.is_leader
+    return jax.process_index() == 0
+
+  def _manifest_extra(self) -> Optional[Dict[str, Any]]:
+    """Elastic provenance for the checkpoint manifest: which member-set
+    epoch wrote it (so a post-mortem can tell a degraded-pod checkpoint
+    from a full-strength one)."""
+    if self.pod is None:
+      return None
+    return {'pod_epoch': int(self.pod.epoch),
+            'pod_members': [int(m) for m in self.pod.members]}
+
   # ---- steps ---------------------------------------------------------
   def train_step_fn(self, state: Optional[TrainState] = None):
     loss_obj = self.loss_fn
@@ -308,11 +336,80 @@ class Trainer:
         donate_argnums=(0,),
     )
 
-  def _batch_sharding(self):
+  def grad_step_fn(self, state: Optional[TrainState] = None):
+    """First half of the elastic-pod data plane: forward+backward on
+    this host's batch slice only, returning (grads, new_model_state,
+    metrics) WITHOUT applying, so the pod's bounded weighted-mean
+    allreduce (ElasticPod.step_sync) runs between compute and update.
+    No donation and no pinned batch sharding: the same state re-enters
+    apply_step_fn (and re-enters here when a lost-host rebuild replays
+    the step), and the batch's leading dim changes with membership, so
+    shapes/shardings are inferred per call."""
+    del state  # shardings inferred from the concrete (placed) arguments
+    loss_obj = self.loss_fn
+
+    def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+      rng = jax.random.fold_in(state.dropout_rng, state.step)
+      mutable = list(state.model_state.keys())
+
+      def loss_of(p):
+        if mutable:
+          preds, new_model_state = state.apply_fn(
+              {'params': p, **state.model_state},
+              batch['rows'], train=True, rngs={'dropout': rng},
+              mutable=mutable,
+          )
+        else:
+          preds = state.apply_fn(
+              {'params': p}, batch['rows'], train=True,
+              rngs={'dropout': rng},
+          )
+          new_model_state = {}
+        return loss_obj(batch['label'], preds), (preds, new_model_state)
+
+      (loss, (preds, new_model_state)), grads = jax.value_and_grad(
+          loss_of, has_aux=True
+      )(state.params)
+      correct, total = metrics_lib.per_example_accuracy_counts(
+          batch['label'], preds
+      )
+      metrics = {
+          'loss': loss,
+          'accuracy_correct': correct,
+          'accuracy_total': total,
+      }
+      return grads, new_model_state, metrics
+
+    return partition_rules.compile_parallel(step)
+
+  def apply_step_fn(self, state: Optional[TrainState] = None):
+    """Second half: applies the pod-averaged gradients (and merged
+    model_state) to the local state replica. Every member applies the
+    SAME averaged arrays to the SAME state, so replicas stay in sync
+    without any cross-host state transfer. grad_norm is computed on the
+    averaged gradients — the same quantity the fused single-mesh step
+    reports for the whole global batch."""
+    del state
+    def step(state: TrainState, grads, new_model_state):
+      if new_model_state:
+        new_state = state.apply_gradients(
+            grads=grads, model_state=new_model_state
+        )
+      else:
+        new_state = state.apply_gradients(grads=grads)
+      return new_state, optax.global_norm(grads)
+
+    return partition_rules.compile_parallel(step, donate_argnums=(0,))
+
+  def _batch_sharding(self, n: Optional[int] = None):
     """Shard the batch over the data axis when divisible, else
-    replicate (tiny test batches)."""
+    replicate (tiny test batches, uneven elastic member slices). `n`
+    overrides the configured global batch size — elastic pod members
+    feed membership-dependent slices whose length params.batch_size no
+    longer describes."""
     dp = self.mesh.shape[mesh_lib.DATA_AXIS]
-    if self.params.batch_size % dp == 0:
+    n = int(self.params.batch_size) if n is None else int(n)
+    if n % dp == 0:
       return mesh_lib.batch_sharding(self.mesh)
     return mesh_lib.replicated(self.mesh)
 
@@ -338,6 +435,31 @@ class Trainer:
         k: distributed.host_local_to_global(self.mesh, spec, v[sl])
         for k, v in batch.items()
     }
+
+  def localize_batch(self, batch):
+    """The training-input view of one loaded batch on THIS host.
+
+    Elastic pod: every member loads the SAME global batch (same files,
+    same seed) and trains on its member_batch_slice — the union covers
+    every row exactly once at ANY member count, so a pod of one
+    degrades to the full batch and survivor training matches the
+    undisturbed run. With shard_streams the batch is already host-local
+    data and passes through. Legacy multi-host delegates to
+    globalize_batch; single everything is a no-op.
+    """
+    if self.pod is None:
+      return self.globalize_batch(batch)
+    if not self.pod_slices_batches:
+      return batch
+    members = self.pod.members
+    if len(members) <= 1:
+      return batch
+    from deepconsensus_tpu.parallel import distributed
+
+    n = next(iter(batch.values())).shape[0]
+    sl = distributed.member_batch_slice(
+        n, len(members), sorted(members).index(self.pod.host_id))
+    return {k: v[sl] for k, v in batch.items()}
 
   def eval_step_fn(self, state: Optional[TrainState] = None):
     loss_obj = self.loss_fn
@@ -429,15 +551,39 @@ class Trainer:
         'model_state': jax.device_get(state.model_state),
         'step': step,
     }
-    # Multi-host: EVERY process calls save — orbax's multihost protocol
-    # barriers across processes and writes from the primary only.
-    self._checkpointer.save(path, saved, force=True)
-    # Block until the async write finalizes so a crash right after this
-    # point never leaves a half-written latest checkpoint.
-    wait = getattr(self._checkpointer, 'wait_until_finished', None)
-    if wait is not None:
-      wait()
-    if jax.process_index() != 0:
+    def do_save():
+      self._checkpointer.save(path, saved, force=True)
+      # Block until the async write finalizes so a crash right after
+      # this point never leaves a half-written latest checkpoint.
+      wait = getattr(self._checkpointer, 'wait_until_finished', None)
+      if wait is not None:
+        wait()
+
+    if self.pod is not None:
+      # Elastic pod: each member is its own single-process jax runtime
+      # sharing out_dir, so orbax's multihost protocol does not apply —
+      # the leader writes alone and a bounded pod barrier aligns the
+      # rest (deadline scaled well above the step barrier: checkpoint
+      # IO legitimately takes longer than a gradient sync).
+      if self._is_writer():
+        do_save()
+      if len(self.pod.members) > 1:
+        self.pod.barrier(
+            f'ckpt-{step}',
+            timeout_s=max(60.0, 4.0 * self.pod.barrier_timeout))
+    elif jax.process_count() > 1:
+      # Legacy multi-host: EVERY process calls save — orbax's multihost
+      # protocol barriers across processes and writes from the primary
+      # only. Bounded (the PR-18 rule: no collective waits forever): a
+      # peer dying inside the save barrier surfaces as HostLostError
+      # for the retry wrapper instead of hanging every survivor.
+      from deepconsensus_tpu.parallel import elastic as elastic_lib
+
+      elastic_lib.bounded_call(
+          do_save, self._save_timeout(), f'orbax-save-{step}')
+    else:
+      do_save()
+    if not self._is_writer():
       # Metric sidecars (TSV, best-checkpoint) and manifests have one
       # writer.
       return path
@@ -446,7 +592,8 @@ class Trainer:
     # its file inventory lets latest_valid_checkpoint detect truncation
     # without loading arrays.
     checkpoints_lib.write_manifest(
-        path, step, digest=checkpoints_lib.tree_digest(saved)
+        path, step, digest=checkpoints_lib.tree_digest(saved),
+        extra=self._manifest_extra(),
     )
     if not eval_metrics:
       # Emergency (preemption) saves carry no eval pass; skip the
@@ -480,6 +627,13 @@ class Trainer:
       with open(self._best_file, 'w') as f:
         f.write(f'checkpoint-{step}\n')
     return path
+
+  def _save_timeout(self) -> float:
+    """Deadline for the legacy multi-host orbax save barrier: generous
+    (checkpoint IO is slow) but finite."""
+    base = float(
+        self.params.get('elastic_barrier_timeout', 30.0) or 30.0)
+    return max(300.0, 10.0 * base)
 
   def restore_checkpoint(self, state: TrainState, path: str,
                          params_only: bool = False) -> TrainState:
@@ -521,14 +675,14 @@ class Trainer:
     step numbers only and would happily resume onto a half-written
     directory."""
     return checkpoints_lib.latest_valid_checkpoint(
-        self._ckpt_dir, quarantine=jax.process_index() == 0
+        self._ckpt_dir, quarantine=self._is_writer()
     )
 
   # Backward-compatible name; validation semantics included.
   latest_checkpoint = latest_valid_checkpoint
 
   def log_metrics(self, step: int, split: str, metrics: Dict[str, float]):
-    if jax.process_index() != 0:
+    if not self._is_writer():
       return
     for name, value in metrics.items():
       try:
@@ -663,8 +817,9 @@ class TrainBatchPrefetcher:
   def _launch(self, host: Dict[str, np.ndarray]):
     """Issues the async sharded H2D transfer for one host batch and
     returns (mesh generation, device arrays)."""
-    gbatch = self._trainer.globalize_batch(dict(host))
-    sh = self._trainer._batch_sharding()
+    gbatch = self._trainer.localize_batch(dict(host))
+    sh = self._trainer._batch_sharding(
+        n=next(iter(gbatch.values())).shape[0])
     with self._lock:
       gen = self._generation
       self._n_launched += 1
@@ -702,10 +857,13 @@ class TrainBatchPrefetcher:
 
   def place(self, host: Dict[str, np.ndarray]):
     """Direct (non-overlapped) placement of a host batch on the
-    CURRENT mesh — used to re-dispatch the failed batch after a
-    degrade and to refresh stale prefetched transfers."""
-    gbatch = self._trainer.globalize_batch(dict(host))
-    sh = self._trainer._batch_sharding()
+    CURRENT mesh (and, for elastic pods, the CURRENT membership —
+    re-placing after a rebuild re-slices the same host batch for the
+    surviving member set) — used to re-dispatch the failed batch after
+    a degrade/rebuild and to refresh stale prefetched transfers."""
+    gbatch = self._trainer.localize_batch(dict(host))
+    sh = self._trainer._batch_sharding(
+        n=next(iter(gbatch.values())).shape[0])
     with self._lock:
       self._n_replaced += 1
     return jax.device_put(gbatch, {k: sh for k in gbatch})
@@ -755,13 +913,18 @@ class PreemptionGuard:
   Multi-host: the decision to stop must be unanimous — the orbax save
   is collective, so one host checkpointing alone would deadlock the
   rest. requested() allgathers the local flags and trips when ANY host
-  saw a signal.
+  saw a signal. The vote is BOUNDED (PR 18): a peer that died before
+  voting surfaces as HostLostError after barrier_timeout instead of
+  wedging every survivor inside process_allgather forever. Elastic
+  pods skip the collective entirely — they piggyback `local()` on the
+  per-step sync, which is already bounded.
   """
 
-  def __init__(self):
+  def __init__(self, barrier_timeout: float = 30.0):
     self._event = threading.Event()
     self._prev: Dict[int, Any] = {}
     self.signum: Optional[int] = None
+    self.barrier_timeout = float(barrier_timeout)
 
   def install(self) -> 'PreemptionGuard':
     import signal
@@ -789,15 +952,26 @@ class PreemptionGuard:
         'boundary (send again to abort immediately)', signum,
     )
 
+  def local(self) -> bool:
+    """This host's own stop flag, no collective — what the elastic pod
+    piggybacks as its stop vote on step_sync."""
+    return self._event.is_set()
+
   def requested(self) -> bool:
     local = self._event.is_set()
     if jax.process_count() == 1:
       return local
     from jax.experimental import multihost_utils
 
-    flags = multihost_utils.process_allgather(
-        np.asarray([local], dtype=np.int32)
-    )
+    from deepconsensus_tpu.parallel import elastic as elastic_lib
+
+    def vote():
+      return multihost_utils.process_allgather(
+          np.asarray([local], dtype=np.int32)
+      )
+
+    flags = elastic_lib.bounded_call(
+        vote, self.barrier_timeout, 'preemption-stop-vote')
     return bool(np.any(flags))
 
   def restore(self) -> None:
@@ -830,7 +1004,8 @@ class NanSentinel:
   become the "last valid checkpoint" the rollback restores.
   """
 
-  def __init__(self, params: ml_collections.ConfigDict, out_dir: str):
+  def __init__(self, params: ml_collections.ConfigDict, out_dir: str,
+               writer: Optional[bool] = None):
     self.limit = int(params.get('nan_sentinel_steps', 3) or 0)
     self.max_rollbacks = int(params.get('nan_max_rollbacks', 2) or 0)
     self.enabled = self.limit > 0
@@ -838,7 +1013,11 @@ class NanSentinel:
     self.rollbacks = 0
     self.counters: collections.Counter = collections.Counter()
     self._dead_letter = None
-    if self.enabled and jax.process_index() == 0:
+    if writer is None:
+      # Legacy convention; elastic runs pass the leader verdict so the
+      # shared dead-letter file keeps one writer across pod epochs.
+      writer = jax.process_index() == 0
+    if self.enabled and writer:
       self._dead_letter = faults_lib.DeadLetterWriter(
           os.path.join(out_dir, 'training.failed.jsonl'), append=True
       )
@@ -907,6 +1086,7 @@ def run_training(
     eval_every: Optional[int] = None,
     warm_start: Optional[str] = None,
     distributed_config: Optional[Dict[str, Any]] = None,
+    elastic_config: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, float]:
   """End-to-end training driver. Returns final eval metrics.
 
@@ -916,11 +1096,49 @@ def run_training(
   feeds its local slice of the global batch (globalize_batch) and only
   process 0 writes checkpoints/metrics. out_dir must be shared (or at
   least readable) across hosts for crash-resume.
+
+  Elastic multi-host: pass elastic_config (host_id, n_hosts, plus
+  optional barrier_timeout / on_host_error / readmit /
+  heartbeat_interval / shard_streams / defer_join_until_step) instead.
+  Each host runs its own single-process jax over a LOCAL mesh; the
+  membership layer (parallel/elastic.py) forms the pod in
+  <out_dir>/.pod/, gradients cross hosts through the bounded per-step
+  weighted-mean sync, and a lost host triggers the coordinated rebuild
+  (agreement round, epoch bump, batch re-slice, step replay) instead
+  of a hang. docs/training.md "Elastic multi-host training".
   """
   if distributed_config is not None:
     from deepconsensus_tpu.parallel import distributed
 
     distributed.initialize(**distributed_config)
+  pod = None
+  pod_start = None
+  shard_streams = False
+  on_host_error = 'degrade'
+  if elastic_config:
+    from deepconsensus_tpu.parallel import elastic as elastic_lib
+
+    shard_streams = bool(elastic_config.get('shard_streams', False))
+    on_host_error = str(
+        elastic_config.get('on_host_error')
+        or params.get('on_host_error', 'degrade') or 'degrade')
+    defer = int(elastic_config.get('defer_join_until_step', 0) or 0)
+    if not defer:
+      # Subprocess fault drills arm the rejoin hook via the restarted
+      # process's environment (scripts/inject_faults.py host).
+      defer = faults_lib.host_rejoin_step()
+    pod = elastic_lib.ElasticPod(
+        os.path.join(os.path.abspath(out_dir), '.pod'),
+        host_id=int(elastic_config['host_id']),
+        n_hosts=int(elastic_config['n_hosts']),
+        barrier_timeout=float(
+            elastic_config.get('barrier_timeout')
+            or params.get('elastic_barrier_timeout', 30.0) or 30.0),
+        heartbeat_interval=float(
+            elastic_config.get('heartbeat_interval', 0.25) or 0.25),
+        readmit=bool(elastic_config.get('readmit', True)),
+        defer_join_until_step=defer,
+    )
   train_patterns = train_patterns or list(params.train_path)
   eval_patterns = eval_patterns or list(params.eval_path)
   num_epochs = num_epochs or params.num_epochs
@@ -955,8 +1173,18 @@ def run_training(
   )
   decay_steps = steps_per_epoch * params.get('num_epochs_for_decay',
                                              num_epochs)
-  trainer = Trainer(params=params, out_dir=out_dir, mesh=mesh)
-  config_lib.save_params_as_json(out_dir, params)
+  if pod is not None and mesh is None:
+    # The jit-visible mesh of an elastic member never spans processes;
+    # cross-host reduction happens at host level through step_sync.
+    mesh = mesh_lib.local_mesh(tp=int(params.get('tp', 1) or 1))
+  trainer = Trainer(params=params, out_dir=out_dir, mesh=mesh, pod=pod,
+                    pod_slices_batches=not shard_streams)
+  if pod is not None:
+    # Form (or join) the pod BEFORE any shared-filesystem writes so
+    # writer gating (_is_writer == pod leader) is meaningful.
+    pod_start = pod.start()
+  if trainer._is_writer():
+    config_lib.save_params_as_json(out_dir, params)
   state = trainer.init_state(steps_total=decay_steps)
   resume_from = trainer.latest_valid_checkpoint()
   if warm_start and resume_from is not None:
@@ -984,7 +1212,27 @@ def run_training(
   # warm_start seeds only the very first start, so a preempted
   # warm-started run resumes its own progress instead of resetting.
   step = 0
-  if resume_from:
+  if pod_start is not None and pod_start.joined:
+    # Re-admission: adopt the leader's LIVE snapshot (state re-placed
+    # outward at the admission boundary), which supersedes any local
+    # checkpoint — the pod has advanced past what disk remembers.
+    if pod_start.state is None:
+      raise faults_lib.ElasticRebuildError(
+          f'host {pod.host_id} was admitted at epoch {pod_start.epoch} '
+          'but no state snapshot exists for that epoch in the pod dir')
+    host_state = jax.device_get(state)
+    leaves, treedef = jax.tree_util.tree_flatten(host_state)
+    if len(pod_start.state) != len(leaves):
+      raise faults_lib.ElasticRebuildError(
+          f'pod snapshot carries {len(pod_start.state)} leaves but the '
+          f'local state template has {len(leaves)}; the rejoining host '
+          'is running a different model/optimizer config than the pod')
+    state = jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(snap_leaf, dtype=np.asarray(tmpl).dtype)
+                  for snap_leaf, tmpl in zip(pod_start.state, leaves)])
+    step = int(pod_start.step)
+    state = jax.device_put(state, trainer.state_shardings(state))
+  elif resume_from:
     state = trainer.restore_checkpoint(state, resume_from)
     step = int(state.step)
     # Restore materializes host arrays; re-place under the rule table
@@ -992,7 +1240,15 @@ def run_training(
     state = jax.device_put(state, trainer.state_shardings(state))
   # Compiled against the concrete (placed) state: explicit rule-table
   # in/out shardings plus donation keep the optimizer update in place.
-  train_step = trainer.train_step_fn(state)
+  # Elastic pods split the step instead (grad compute / bounded
+  # host-level allreduce / apply), so the compiled graph never contains
+  # a cross-host collective a dead peer could wedge.
+  train_step = grad_step = apply_step = None
+  if pod is None:
+    train_step = trainer.train_step_fn(state)
+  else:
+    grad_step = trainer.grad_step_fn(state)
+    apply_step = trainer.apply_step_fn(state)
 
   # Fleet tracing + on-demand profiler: spans and dead letters from
   # this run carry one minted trace id; SIGUSR2 triggers a short
@@ -1013,12 +1269,22 @@ def run_training(
     # (differently-shuffled) data instead of replaying the head of the
     # corpus. Held in a variable so its fault counters (skipped shards
     # etc.) survive the iterator for the end-of-run summary.
+    if pod is not None and not shard_streams and pod.readmit:
+      logging.getLogger(__name__).warning(
+          'elastic + streaming without shard_streams: a re-admitted '
+          'host reseeds its stream by resume position and so draws '
+          'approximately (not exactly) the batches its peers hold; '
+          'pass shard_streams for per-host shard ownership, or use '
+          'the non-streaming loader for exact replicated batches')
     stream_ds = data_lib.StreamingDataset(
         patterns=train_patterns,
         params=params,
         batch_size=params.batch_size,
         **({'buffer_size': params.buffer_size}
            if 'buffer_size' in params else {}),
+        **({'host_rank': sorted(pod.members).index(pod.host_id),
+            'host_count': len(pod.members)}
+           if (pod is not None and shard_streams) else {}),
         workers=params.get('loader_workers', 0),
         seed=params.seed + step,
         on_shard_error=params.get('on_shard_error', 'fail'),
@@ -1055,8 +1321,13 @@ def run_training(
         for b in train_batches()
     )
 
-  guard = PreemptionGuard().install()
-  sentinel = NanSentinel(params, out_dir)
+  guard = PreemptionGuard(
+      barrier_timeout=float(
+          params.get('elastic_barrier_timeout', 30.0) or 30.0)
+  ).install()
+  sentinel = NanSentinel(
+      params, out_dir,
+      writer=trainer._is_writer() if pod is not None else None)
   # The sentinel reads verdicts one step late (see NanSentinel);
   # pending holds (step, metrics, window ids, host batch) for the step
   # whose device result is not yet known.
@@ -1083,6 +1354,12 @@ def run_training(
     step = int(state.step)
     state = jax.device_put(state, trainer.state_shardings(state))
     pending = None
+    if pod is not None:
+      # Every member judges the same merged metrics, so all roll back
+      # at the same step; bumping the barrier round in lockstep keeps
+      # the replayed step numbers out of their first pass's stale
+      # payload files.
+      pod.advance_round()
     sentinel.rolled_back(latest)
 
   # Training degradation ladder (--on_device_error=degrade): the
@@ -1146,6 +1423,170 @@ def run_training(
     )
     return True
 
+  # Elastic host-loss handling (--on_host_error=degrade): the pod-scale
+  # sibling of degrade_mesh. A HostLostError from any bounded barrier
+  # triggers the survivor-side agreement round; the member set shrinks,
+  # the epoch bumps, batches re-slice over the survivors, and the
+  # failed step replays under the new epoch's barrier namespace.
+  def rebuild_after_host_loss(err: Exception) -> bool:
+    """Returns True when this host adopted a peer's AHEAD state: the
+    lost host died inside a step barrier some members had already
+    collected, so the pod split across a step boundary; the
+    most-advanced member snapshots its live state and the rest adopt
+    it — forward reconciliation, never a checkpoint rollback (that is
+    reserved for state that died with a host, mirroring the PR-14
+    degrade rule)."""
+    nonlocal state, step, pending
+    t0 = time.time()
+    old_members = pod.members
+    members = ()
+    got = None
+    for _ in range(max(pod.rebuild_attempts, 1)):
+      members = pod.rebuild()
+      try:
+        got = pod.allgather('resume', {'step': int(step)})
+        break
+      except faults_lib.HostLostError as resume_err:
+        # Another member died between the agreement round and the
+        # resume exchange; rebuild again without it.
+        err = resume_err
+    if got is None:
+      raise faults_lib.ElasticRebuildError(
+          f'pod resume exchange never converged after '
+          f'{pod.rebuild_attempts} rebuild(s); last error: {err}')
+    steps = {int(h): int(meta['step']) for h, (meta, _) in got.items()}
+    max_step = max(steps.values())
+    adopted = False
+    if len(set(steps.values())) > 1:
+      max_host = min(h for h, s in steps.items() if s == max_step)
+      if pod.host_id == max_host:
+        pod.write_state_snapshot(
+            pod.epoch, max_step,
+            [np.asarray(x) for x in
+             jax.tree_util.tree_flatten(jax.device_get(state))[0]])
+      pod.barrier('resume-adopt')
+      if steps[pod.host_id] < max_step:
+        snap = pod.read_state_snapshot(pod.epoch)
+        if snap is None:
+          raise faults_lib.ElasticRebuildError(
+              f'resume snapshot for epoch {pod.epoch} missing after '
+              'the adopt barrier; pod dir inconsistent')
+        leaves, treedef = jax.tree_util.tree_flatten(
+            jax.device_get(state))
+        state = jax.tree_util.tree_unflatten(
+            treedef,
+            [np.asarray(s_leaf, dtype=np.asarray(t).dtype)
+             for s_leaf, t in zip(snap, leaves)])
+        step = max_step
+        pending = None
+        adopted = True
+    # Re-place the live TrainState by the rule table. The mesh is
+    # host-local and unchanged, so this is cheap — placement is only
+    # actually rebuilt for host-materialized (adopted) leaves.
+    state = jax.device_put(state, trainer.state_shardings(state))
+    if jax.process_count() > 1:
+      # Real multi-controller pod: re-enter initialize_distributed
+      # semantics at the agreed process count.
+      from deepconsensus_tpu.parallel import distributed
+
+      distributed.reinitialize(
+          num_processes=len(members),
+          process_id=sorted(members).index(pod.host_id))
+    if prefetcher is not None:
+      prefetcher.retarget()
+    if stream_ds is not None and shard_streams:
+      stream_ds.reassign_hosts(
+          sorted(members).index(pod.host_id), len(members))
+    obs_lib.trace.complete_event('host_rebuild', 'train', t0, time.time(), {
+        'epoch': pod.epoch,
+        'missing': [int(h) for h in getattr(err, 'missing', ()) or ()],
+        'members_before': len(old_members),
+        'members_after': len(members),
+        'adopted_peer_state': adopted,
+    })
+    logging.getLogger(__name__).warning(
+        'pod rebuilt after host loss (%s): members %s -> %s, epoch %d%s',
+        err, sorted(old_members), sorted(members), pod.epoch,
+        '; adopted the most-advanced survivor state' if adopted else '')
+    return adopted
+
+  def admit_joiners(joiners, at_step: int) -> None:
+    """Survivor side of re-admission, at a step boundary: snapshot the
+    live state outward, agree on the expanded member set, retarget the
+    input pipeline to the new membership."""
+    t0 = time.time()
+    members = pod.admit(
+        joiners,
+        [np.asarray(x) for x in
+         jax.tree_util.tree_flatten(jax.device_get(state))[0]],
+        at_step)
+    if jax.process_count() > 1:
+      from deepconsensus_tpu.parallel import distributed
+
+      distributed.reinitialize(
+          num_processes=len(members),
+          process_id=sorted(members).index(pod.host_id))
+    if prefetcher is not None:
+      prefetcher.retarget()
+    if stream_ds is not None and shard_streams:
+      stream_ds.reassign_hosts(
+          sorted(members).index(pod.host_id), len(members))
+    obs_lib.trace.complete_event(
+        'host_readmit', 'train', t0, time.time(),
+        {'epoch': pod.epoch, 'joiners': [int(j) for j in joiners],
+         'members': len(members), 'step': int(at_step)})
+    logging.getLogger(__name__).warning(
+        'pod re-admitted %s at the step %d boundary: members now %s '
+        '(epoch %d)', sorted(joiners), at_step, sorted(members),
+        pod.epoch)
+
+  def elastic_step(batch):
+    """One pod-synchronized training step: local grads on this host's
+    batch slice, bounded weighted-mean allreduce across members,
+    identical apply everywhere. Returns (merged metrics, StepSync)."""
+    nonlocal state
+    grads, new_mstate, m_local = grad_step(state, batch)
+    g_leaves, g_treedef = jax.tree_util.tree_flatten(
+        jax.device_get((grads, new_mstate)))
+    sync = pod.step_sync(
+        step + 1,
+        [np.asarray(leaf, np.float32) for leaf in g_leaves],
+        weight=float(next(iter(batch.values())).shape[0]),
+        meta={
+            'loss': float(m_local['loss']),
+            'acc_correct': float(m_local['accuracy_correct']),
+            'acc_total': float(m_local['accuracy_total']),
+        },
+        stop_vote=guard.local(),
+    )
+    avg_grads, avg_mstate = jax.tree_util.tree_unflatten(
+        g_treedef, sync.arrays)
+    state, grad_norm = apply_step(state, avg_grads, avg_mstate)
+    total = sync.weight_total
+    merged = {
+        # Per-host losses are slice means; their weighted mean is the
+        # exact global-batch mean. Accuracy counts just sum.
+        'loss': sum(meta['loss'] * meta['weight']
+                    for meta in sync.metas.values()) / total,
+        'grad_norm': grad_norm,
+        'accuracy_correct': sum(
+            meta['acc_correct'] for meta in sync.metas.values()),
+        'accuracy_total': sum(
+            meta['acc_total'] for meta in sync.metas.values()),
+    }
+    return merged, sync
+
+  def pod_safe_save(at_step: int, metrics: Dict[str, float]) -> None:
+    """save_checkpoint, with a peer death inside the checkpoint barrier
+    handled like any other host loss (the leader's write is already
+    intact or will be redone at the next boundary)."""
+    try:
+      trainer.save_checkpoint(state, at_step, metrics)
+    except faults_lib.HostLostError as host_err:
+      if pod is None or on_host_error != 'degrade':
+        raise
+      rebuild_after_host_loss(host_err)
+
   preempted = False
   final_metrics: Dict[str, float] = {}
   try:
@@ -1161,22 +1602,51 @@ def run_training(
     )
     t_step = time.time()
     for names, host_batch, batch in prefetcher:
-      try:
-        faults_lib.injected_train_device_fault(step + 1)
-        with jax.profiler.StepTraceAnnotation('train', step_num=step):
-          state, m = train_step(state, batch)
-      except Exception as e:  # pylint: disable=broad-except
-        err = faults_lib.classify_device_error(e)
-        if (on_device_error != 'degrade'
-            or not isinstance(err, faults_lib.DeviceLostError)):
-          raise
-        if not degrade_mesh():
-          raise err
-        # The failed batch was consumed from the pipeline but never
-        # applied: re-place it on the rebuilt mesh and re-run.
-        batch = prefetcher.place(host_batch)
-        with jax.profiler.StepTraceAnnotation('train', step_num=step):
-          state, m = train_step(state, batch)
+      sync = None
+      if pod is not None:
+        # The host-loss drill hook fires BEFORE the step so the death
+        # lands mid-barrier for the survivors, like a real SIGKILL.
+        faults_lib.maybe_host_lost(step + 1, pod.host_id, pod.abandon)
+        m = None
+        attempts = 0
+        while True:
+          try:
+            with jax.profiler.StepTraceAnnotation('train', step_num=step):
+              m, sync = elastic_step(batch)
+            break
+          except faults_lib.HostLostError as host_err:
+            attempts += 1
+            if (on_host_error != 'degrade'
+                or attempts > pod.rebuild_attempts):
+              raise
+            if rebuild_after_host_loss(host_err):
+              # This host adopted a peer state AHEAD of its own, so
+              # the batch in hand was already applied pod-wide; drop
+              # it (adoption advanced `step`) and realign on the next.
+              break
+            # The failed step never committed (apply only runs after a
+            # full collect): re-slice this same host batch for the
+            # surviving member set and replay it under the new epoch.
+            batch = prefetcher.place(host_batch)
+        if m is None:
+          continue
+      else:
+        try:
+          faults_lib.injected_train_device_fault(step + 1)
+          with jax.profiler.StepTraceAnnotation('train', step_num=step):
+            state, m = train_step(state, batch)
+        except Exception as e:  # pylint: disable=broad-except
+          err = faults_lib.classify_device_error(e)
+          if (on_device_error != 'degrade'
+              or not isinstance(err, faults_lib.DeviceLostError)):
+            raise
+          if not degrade_mesh():
+            raise err
+          # The failed batch was consumed from the pipeline but never
+          # applied: re-place it on the rebuilt mesh and re-run.
+          batch = prefetcher.place(host_batch)
+          with jax.profiler.StepTraceAnnotation('train', step_num=step):
+            state, m = train_step(state, batch)
       step += 1
       # Per-iteration wall time (dispatch-to-dispatch, which converges
       # to device step time once the pipeline fills) feeds the registry
@@ -1188,6 +1658,10 @@ def run_training(
       t_step = t_now
       faults_lib.maybe_kill_train_at_step(step)
       faults_lib.maybe_sigterm_at_step(step)
+      if pod is not None and sync is not None and sync.join_requests:
+        # Re-admission lands exactly at a step boundary: every member
+        # saw the same join requests piggybacked on this step's sync.
+        admit_joiners(sync.join_requests, step)
       if sentinel.enabled:
         if pending is not None and sentinel.observe(*pending):
           if sentinel.should_rollback():
@@ -1221,8 +1695,13 @@ def run_training(
         else:
           final_metrics = run_eval(state)
           trainer.log_metrics(step, 'eval', final_metrics)
-          trainer.save_checkpoint(state, step, final_metrics)
-      if guard.requested():
+          pod_safe_save(step, final_metrics)
+      # Elastic pods read the stop decision off the step sync (bounded,
+      # unanimous-by-construction: every member merged the same votes);
+      # legacy runs take the allgather vote, now also bounded.
+      stop_requested = (bool(sync is not None and sync.stop)
+                        if pod is not None else guard.requested())
+      if stop_requested:
         # Emergency checkpoint at the step boundary, then a clean
         # return: the retry wrapper / scheduler restarts from it.
         # Same contamination guard as above: resuming from a NaN
@@ -1237,7 +1716,7 @@ def run_training(
               'back to the last valid checkpoint', step,
           )
         else:
-          trainer.save_checkpoint(state, step, {})
+          pod_safe_save(step, {})
         final_metrics = {'preempted': 1.0, 'stop_step': float(step)}
         preempted = True
         logging.getLogger(__name__).warning(
@@ -1255,13 +1734,19 @@ def run_training(
         rollback()
       final_metrics = run_eval(state)
       trainer.log_metrics(step, 'eval', final_metrics)
-      trainer.save_checkpoint(state, step, final_metrics)
+      pod_safe_save(step, final_metrics)
   finally:
     if prefetcher is not None:
       prefetcher.close()
     guard.restore()
     sentinel.close()
     fault_counters: Dict[str, float] = dict(sentinel.counters)
+    if pod is not None:
+      # pod_epoch / n_host_rebuilds / n_host_readmissions /
+      # n_barrier_timeouts land in the same `faults` split the other
+      # resilience counters use.
+      fault_counters.update(pod.counters())
+      pod.close()
     if stream_ds is not None:
       fault_counters.update(stream_ds.counters)
     if prefetcher is not None:

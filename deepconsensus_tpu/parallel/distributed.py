@@ -11,6 +11,7 @@ import logging
 from typing import Optional
 
 import jax
+import numpy as np
 
 log = logging.getLogger(__name__)
 
@@ -40,11 +41,57 @@ def initialize(
   )
 
 
+def reinitialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+  """Re-enters `initialize` semantics after an elastic membership
+  change: tears down the current jax.distributed client and re-forms
+  at the agreed process count (survivor count after a rebuild, full
+  count after a re-admission). Only meaningful on a real
+  multi-controller pod — the elastic CPU/file-transport pod keeps each
+  host single-process and never calls this."""
+  if jax.process_count() == 1:
+    return
+  try:
+    jax.distributed.shutdown()
+  # dclint-style teardown: the old cohort is gone; a shutdown barrier
+  # failing against dead peers is exactly the condition being repaired.
+  except Exception as e:  # pylint: disable=broad-except
+    log.warning('jax.distributed shutdown before re-init failed '
+                '(expected when peers died): %s', e)
+  jax.distributed.initialize(
+      coordinator_address=coordinator_address,
+      num_processes=num_processes,
+      process_id=process_id,
+  )
+  log.info(
+      'distributed re-initialized: process %d/%d, %d local / %d global '
+      'devices', jax.process_index(), jax.process_count(),
+      jax.local_device_count(), jax.device_count(),
+  )
+
+
 def local_batch_slice(global_batch_size: int) -> slice:
   """The slice of the global batch this host should feed."""
   per_host = global_batch_size // jax.process_count()
   start = jax.process_index() * per_host
   return slice(start, start + per_host)
+
+
+def member_batch_slice(global_batch_size: int, n_members: int,
+                       rank: int) -> slice:
+  """The contiguous rows of the global batch that pod member `rank`
+  (position in the sorted member set, not host id) owns. np.array_split
+  semantics: when the batch doesn't divide evenly the first
+  `global_batch_size % n_members` members take one extra row, so every
+  row is owned exactly once at ANY member count — the property the
+  elastic rebuild relies on when n_members changes mid-run."""
+  bounds = [len(part) for part in
+            np.array_split(np.arange(global_batch_size), n_members)]
+  start = sum(bounds[:rank])
+  return slice(start, start + bounds[rank])
 
 
 def host_local_to_global(mesh, pspec, local_array):

@@ -39,6 +39,15 @@ def make_mesh(
   return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def local_mesh(tp: int = 1) -> Mesh:
+  """A mesh over THIS host's devices only. The jit-visible mesh of an
+  elastic pod member: cross-host reduction happens at host level
+  through `parallel/elastic.py` step_sync, so the compiled step never
+  spans processes and a lost host can never wedge a collective inside
+  XLA."""
+  return make_mesh(tp=tp, devices=jax.local_devices())
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
   return NamedSharding(mesh, P())
 

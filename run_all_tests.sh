@@ -24,6 +24,12 @@
 #                                  # bf16/int8 accuracy gates, fused
 #                                  # encoder-block parity, export
 #                                  # lever baking/mismatch
+#   ./run_all_tests.sh elastic     # elastic multi-host training only:
+#                                  # bounded pod barriers, the
+#                                  # kill-one-host rebuild drill, host
+#                                  # re-admission, and the subprocess
+#                                  # SIGKILL drill through the CLI
+#                                  # (slow, included in this mode)
 #   ./run_all_tests.sh fleet       # fleet tier only: `dctpu route`
 #                                  # balancing/retry semantics,
 #                                  # featurize workers, protocol
@@ -98,6 +104,10 @@ fi
 
 if [[ "${1:-}" == "quant" ]]; then
   exec python -m pytest tests/ -q -m quant
+fi
+
+if [[ "${1:-}" == "elastic" ]]; then
+  exec scripts/run_resilience.sh --elastic
 fi
 
 if [[ "${1:-}" == "fleet" ]]; then

@@ -488,6 +488,20 @@ def main(argv: Optional[List[str]] = None) -> int:
           'after start — /readyz flips to 503 draining, admitted work '
           'finishes, clean exit with preempted=true (same path as an '
           'external SIGUSR1 / `preempt` below)\n'
+          '  DCTPU_FAULT_HOST_LOST_AT_STEP=N   elastic training: this '
+          'host dies at the Nth step (1-based, fires once) — '
+          'survivors hit a bounded barrier timeout, name the missing '
+          'host in HostLostError, and (with --on_host_error=degrade) '
+          'rebuild the pod and keep training\n'
+          '  DCTPU_FAULT_HOST_LOST_HOST=<id>   scope HOST_LOST to one '
+          'pod host id (default: every host)\n'
+          '  DCTPU_FAULT_HOST_LOST_MODE=<m>    kill (default): '
+          'SIGKILL the process, the hard drill; drop: leave the '
+          'heartbeat thread running but abandon the barriers, the '
+          'zombie-host drill\n'
+          '  DCTPU_FAULT_HOST_REJOIN_AT_STEP=N a restarted host '
+          'defers its join request until the pod reaches step N '
+          '(1-based) — paces re-admission drills\n'
       ),
   )
   sub = parser.add_subparsers(dest='command', required=True)
@@ -562,6 +576,28 @@ def main(argv: Optional[List[str]] = None) -> int:
   p.add_argument('--hang_s', type=float, default=30.0,
                  help='hang: seconds the finalize sleeps (pair with '
                  '--dispatch_timeout below it).')
+  p.add_argument('cmd', nargs=argparse.REMAINDER,
+                 help='Command to exec with the hook armed; without '
+                 'one, print the env assignments to eval.')
+
+  p = sub.add_parser('host',
+                     help='Arm an elastic host-fault hook (die at a '
+                     'train step, optionally scoped to one host / '
+                     'deferred rejoin) and optionally exec a command '
+                     'under it.')
+  p.add_argument('--lost_at_step', type=int, default=None,
+                 help='1-based train step at which the host dies '
+                 '(fires once per process).')
+  p.add_argument('--host', type=int, default=None,
+                 help='Pod host id to kill (default: every host that '
+                 'reaches the step).')
+  p.add_argument('--mode', choices=('kill', 'drop'), default='kill',
+                 help='kill: SIGKILL the process (hard drill). '
+                 'drop: abandon the pod barriers but keep the '
+                 'process alive (zombie-host drill).')
+  p.add_argument('--rejoin_at_step', type=int, default=None,
+                 help='Defer a restarted host\'s join request until '
+                 'the pod reaches this 1-based step.')
   p.add_argument('cmd', nargs=argparse.REMAINDER,
                  help='Command to exec with the hook armed; without '
                  'one, print the env assignments to eval.')
@@ -645,6 +681,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     }[args.fault]
     if args.step is not None:
       env = {faults_lib.ENV_DEVICE_LOST_AT_STEP: str(args.step)}
+    cmd = [c for c in args.cmd if c != '--']
+    if not cmd:
+      for key, value in env.items():
+        print(f'export {key}={value}')
+      return 0
+    os.environ.update(env)
+    os.execvp(cmd[0], cmd)
+
+  if args.command == 'host':
+    from deepconsensus_tpu import faults as faults_lib
+
+    if args.lost_at_step is None and args.rejoin_at_step is None:
+      parser.error('nothing to arm: pass --lost_at_step and/or '
+                   '--rejoin_at_step')
+    env = {}
+    if args.lost_at_step is not None:
+      env[faults_lib.ENV_HOST_LOST_AT_STEP] = str(args.lost_at_step)
+      if args.host is not None:
+        env[faults_lib.ENV_HOST_LOST_HOST] = str(args.host)
+      if args.mode != 'kill':
+        env[faults_lib.ENV_HOST_LOST_MODE] = args.mode
+    if args.rejoin_at_step is not None:
+      env[faults_lib.ENV_HOST_REJOIN_AT_STEP] = str(args.rejoin_at_step)
     cmd = [c for c in args.cmd if c != '--']
     if not cmd:
       for key, value in env.items():

@@ -25,6 +25,15 @@
 #                                         # watchdog, OOM bisection,
 #                                         # mesh degradation (dp 8->4)
 #                                         # incl. byte-identity drills
+#   scripts/run_resilience.sh --elastic   # elastic multi-host domain
+#                                         # only: bounded pod barriers
+#                                         # (timeout sweep), the
+#                                         # kill-one-host rebuild drill
+#                                         # and the re-admission drill
+#                                         # (in-process threaded pods),
+#                                         # plus the real subprocess
+#                                         # SIGKILL drill through the
+#                                         # CLI (slow, included here)
 #   scripts/run_resilience.sh --fleet     # fleet tier only: `dctpu
 #                                         # route` balancing + retry
 #                                         # semantics, featurize
@@ -74,6 +83,18 @@ if [[ "${1:-}" == "--device" ]]; then
   exec timeout -k 10 900 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_device_faults.py \
     tests/test_train_parallel.py \
+    -q --continue-on-collection-errors "$@"
+fi
+
+if [[ "${1:-}" == "--elastic" ]]; then
+  shift
+  # The elastic multi-host domain in isolation, slow tests included
+  # (the subprocess SIGKILL drill through the CLI is the acceptance
+  # demo): bounded barriers, coordinated pod rebuild, host
+  # re-admission, and the bounded legacy collectives (stop vote,
+  # orbax save).
+  exec timeout -k 10 1200 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_elastic.py \
     -q --continue-on-collection-errors "$@"
 fi
 

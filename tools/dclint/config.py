@@ -31,6 +31,10 @@ TYPED_FAULTS_SCOPE = (
     # The observability plane is crossed by every request: a bare raise
     # in trace/metrics/summarize code takes the data plane down with it.
     'deepconsensus_tpu/obs/',
+    # The elastic pod layer sits under every multi-host training step:
+    # an untyped raise in a barrier/agreement path escapes the
+    # HostLostError rebuild handler and kills the whole pod.
+    'deepconsensus_tpu/parallel/elastic.py',
 )
 
 # The typed fault taxonomy (deepconsensus_tpu/faults.py plus the
@@ -58,6 +62,9 @@ FAULT_TYPES = frozenset({
     'FleetRejection',
     'ReplicaLostError',
     'QuotaExceededError',
+    'HostLostError',
+    'ElasticRebuildError',
+    'InjectedHostDeath',
     # deepconsensus_tpu/inference/faults.py
     'ZmwFault',
     'WatchdogTimeout',
@@ -209,6 +216,9 @@ GUARDED_BY_SCOPE = (
     # The metrics registry and trace writer are mutated from every
     # handler/model/producer thread in a tier process.
     'deepconsensus_tpu/obs/',
+    # ElasticPod's membership state is shared between the heartbeat
+    # daemon thread and the training loop's barrier/rebuild calls.
+    'deepconsensus_tpu/parallel/elastic.py',
 )
 
 # Attribute initialisers of these types are synchronisation primitives
