@@ -677,6 +677,27 @@ def _add_flywheel(sub):
                  '(default 3, from the acceptance test).')
   p.add_argument('--tp', type=int, default=1,
                  help='Tensor-parallel mesh size for train/distill.')
+  p.add_argument('--resume', action='store_true',
+                 help='Adopt <out_dir>/flywheel_journal.json: skip '
+                 'completed stages (inputs re-validated — a changed '
+                 'flag raises a typed FlywheelResumeError, exit 2) and '
+                 're-enter the in-flight stage idempotently.')
+  p.add_argument('--elastic', action='store_true',
+                 help='Run the train and distill stages under the '
+                 'elastic pod protocol (dctpu train --elastic); a lost '
+                 'host degrades the pod at the stage retry instead of '
+                 'killing the cycle.')
+  p.add_argument('--num_processes', type=int, default=None,
+                 help='Elastic pod size (hosts).')
+  p.add_argument('--process_id', type=int, default=None,
+                 help='This host\'s id within the elastic pod.')
+  p.add_argument('--on_host_error', choices=('fail', 'degrade'),
+                 default='degrade')
+  p.add_argument('--elastic_barrier_timeout', type=float, default=30.0)
+  p.add_argument('--elastic_readmit', dest='elastic_readmit',
+                 action='store_true', default=True)
+  p.add_argument('--no_elastic_readmit', dest='elastic_readmit',
+                 action='store_false')
   _add_quant_flags(p)
 
 
@@ -1377,6 +1398,15 @@ def _dispatch(args) -> int:
       kwargs['int8_gate_threshold'] = args.int8_gate
     if args.bf16_gate is not None:
       kwargs['bf16_gate_threshold'] = args.bf16_gate
+    elastic_config = None
+    if args.elastic:
+      elastic_config = {
+          'host_id': args.process_id or 0,
+          'n_hosts': args.num_processes or 1,
+          'barrier_timeout': args.elastic_barrier_timeout,
+          'on_host_error': args.on_host_error,
+          'readmit': args.elastic_readmit,
+      }
     try:
       manifest = flywheel_lib.run_flywheel(
           out_dir=args.out_dir,
@@ -1393,14 +1423,27 @@ def _dispatch(args) -> int:
           inference_dtype=args.inference_dtype,
           quantize_matmuls=args.quantize_matmuls,
           mesh=mesh,
+          resume=args.resume,
+          elastic_config=elastic_config,
           **kwargs,
       )
     except faults_lib.FlywheelGateError as e:
       # The partial manifest (with the failing gate recorded) is
       # already on disk; exit 3 distinguishes a gate veto from the
-      # operator-error exit 2.
+      # operator-error exit 2. (FlywheelResumeError is a ValueError:
+      # main() maps it to the operator-error exit 2.)
       print(f'dctpu: {e}', file=sys.stderr)
       return 3
+    if manifest.get('interrupted'):
+      # Preemption mid-cycle is a clean exit, not a failure: the
+      # journal records the stage to re-enter and --resume on the same
+      # out_dir picks the cycle back up.
+      print(json.dumps({
+          'interrupted': manifest['interrupted'],
+          'journal': f'{args.out_dir}/{flywheel_lib.JOURNAL_NAME}',
+          'resume': 'rerun with --resume',
+      }, indent=2))
+      return 0
     print(json.dumps({
         'artifact': manifest['stages']['export']['artifact'],
         'manifest': f'{args.out_dir}/{flywheel_lib.MANIFEST_NAME}',

@@ -233,6 +233,45 @@ class FlywheelGateError(RuntimeError):
     self.threshold = threshold
 
 
+class FlywheelStageError(RuntimeError):
+  """A `dctpu flywheel` stage failed permanently: a non-transient error
+  escaped the stage body, or the stage-level retry loop hit its
+  crash-loop breaker without the stage's progress marker advancing.
+  The failing stage is recorded as `failed` in flywheel_journal.json
+  before this raises, so `--resume` re-enters exactly that stage.
+
+  Permanent by construction (no transient markers): spinning the same
+  flywheel again reproduces the same failure; the journal entry carries
+  the cause for the operator instead."""
+
+  def __init__(self, stage: str, detail: str):
+    super().__init__(f'flywheel stage {stage!r} failed: {detail}')
+    self.stage = stage
+
+
+class FlywheelResumeError(ValueError):
+  """`dctpu flywheel --resume` found a journal whose recorded stage
+  inputs do not match this invocation: a completed stage's outputs were
+  produced under different parameters, so skipping it would silently
+  publish an artifact built from a mixed configuration. Names the first
+  mismatched field and both values so the operator can either restore
+  the original flags or start a fresh cycle (new --out_dir, or drop
+  --resume). Operator error: exit code 2 (ValueError family)."""
+
+  def __init__(self, field: str, journal_value, current_value,
+               stage: str = ''):
+    where = f' (stage {stage!r})' if stage else ''
+    super().__init__(
+        f'flywheel journal mismatch on field {field!r}{where}: journal '
+        f'recorded {journal_value!r} but this invocation has '
+        f'{current_value!r}; restore the original flags or start a '
+        f'fresh cycle without --resume')
+    self.field = field
+    self.journal_value = journal_value
+    self.current_value = current_value
+    self.stage = stage
+
+
 class ExportedArtifactMismatchError(ValueError):
   """An exported StableHLO artifact cannot serve the requested topology
   (fixed-batch artifact under a --dp mesh, or any mesh with a model
@@ -517,6 +556,13 @@ ENV_HOST_LOST_AT_STEP = 'DCTPU_FAULT_HOST_LOST_AT_STEP'
 ENV_HOST_LOST_HOST = 'DCTPU_FAULT_HOST_LOST_HOST'
 ENV_HOST_LOST_MODE = 'DCTPU_FAULT_HOST_LOST_MODE'
 ENV_HOST_REJOIN_AT_STEP = 'DCTPU_FAULT_HOST_REJOIN_AT_STEP'
+# Flywheel orchestration hook (`inject_faults.py flywheel`): SIGKILL
+# the flywheel process right after the named stage (train | distill |
+# gates | export) commits its `running` journal entry — the stage
+# boundary where the durable-resume guarantee is cheapest to break.
+# Consume-once per process; honors ENV_KILL_TOKEN so a drill can arm
+# one kill across a whole kill/resume sequence.
+ENV_FLYWHEEL_KILL_AT_STAGE = 'DCTPU_FAULT_FLYWHEEL_KILL_AT_STAGE'
 
 # Hooks that already fired in this process (consume-once semantics:
 # after a NaN-sentinel rollback the training loop passes the same step
@@ -608,6 +654,23 @@ def maybe_kill_train_at_step(step: int) -> None:
     return
   import signal
 
+  os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_flywheel_at_stage(stage: str) -> None:
+  """SIGKILLs the flywheel process when fault injection targets this
+  stage boundary. Fires once per process (a resumed flywheel passes
+  earlier stage names again as it skips them) and honors
+  ENV_KILL_TOKEN so the restarted run survives the same environment."""
+  target = os.environ.get(ENV_FLYWHEEL_KILL_AT_STAGE)
+  if not target or target != stage or ENV_FLYWHEEL_KILL_AT_STAGE in _fired:
+    return
+  _fired.add(ENV_FLYWHEEL_KILL_AT_STAGE)
+  if not _claim_token():
+    return
+  import signal
+
+  log.warning('fault injection: SIGKILL at flywheel stage %r', stage)
   os.kill(os.getpid(), signal.SIGKILL)
 
 

@@ -32,6 +32,16 @@ FUSED_MAX_WINDOW_LEN = 128
 # configs' target geometry, arxiv 2211.09862).
 DEFAULT_WINDOW_BUCKETS = (100, 200)
 
+# Quantization acceptance gates — the ONE shared home. The runtime
+# gates (models/flywheel.py) and the acceptance tests
+# (tests/test_quantized_inference.py) both import these so the
+# documented thresholds can never drift between test and release gate:
+# int8 — held-out alignment identity within this delta of the f32
+# baseline; bf16 — per-base Phred QVs within this many units of f32 on
+# argmax-agreeing positions.
+INT8_IDENTITY_GATE = 0.002
+BF16_QV_GATE = 3
+
 
 def normalize_window_buckets(buckets, max_length: int):
   """Validate and canonicalize a window-bucket spec.
@@ -388,6 +398,12 @@ def get_config(config_name: Optional[str] = None) -> ml_collections.ConfigDict:
   # letters can attribute a diverged batch to its windows (small
   # decode cost; off by default).
   params.track_window_ids = False
+  # Mid-run checkpoint cadence for distillation (models/distill.py):
+  # save every N steps so a killed/preempted distill stage resumes
+  # from the last save instead of restarting (0 = final-only, the
+  # pre-flywheel behavior). Training proper already checkpoints on its
+  # eval_every_n_steps cadence.
+  params.checkpoint_every_n_steps = 0
 
   if config_name is None:
     return params

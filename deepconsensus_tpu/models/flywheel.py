@@ -4,30 +4,55 @@ One command that turns training data into a servable artifact, with
 the quantization acceptance gates from tests/test_quantized_inference
 enforced AT RUNTIME between distillation and export:
 
-  * int8 gate — held-out alignment identity within 0.002 of the f32
-    baseline (models/evaluate.run_evaluation on both variants);
-  * bf16 gate — per-base quality values within 3 QV of f32 on
-    positions where both precisions call the same base (the FASTQ
-    delta gate, computed from softmax probabilities via the host
+  * int8 gate — held-out alignment identity within
+    config.INT8_IDENTITY_GATE of the f32 baseline
+    (models/evaluate.run_evaluation on both variants);
+  * bf16 gate — per-base quality values within config.BF16_QV_GATE of
+    f32 on positions where both precisions call the same base (the
+    FASTQ delta gate, computed from softmax probabilities via the host
     epilogue oracle ops/output_plane.host_quality_reference).
 
 A failed gate raises faults.FlywheelGateError BEFORE export_model runs
 — an artifact that would serve degraded consensus is never written.
+
+Durability (the orchestration layer): every stage is a `Stage` entry
+in `<out_dir>/flywheel_journal.json` — committed atomically
+(tmp + rename + fsync) with the stage's exact inputs, its outputs
+inventory, and a status in {running, done, failed, interrupted}. A
+crashed or SIGKILLed cycle restarts with `--resume`: completed stages
+whose recorded inputs match and whose outputs still validate are
+skipped, the in-flight stage is re-entered idempotently, and changed
+parameters raise a typed faults.FlywheelResumeError naming the
+mismatched field instead of silently mixing configurations. Transient
+stage failures retry with the run_training_with_retry backoff and
+crash-loop breaker semantics; SIGTERM mid-cycle checkpoints the
+running stage (train/distill support it), marks the journal
+`interrupted`, and exits cleanly. The export publishes atomically:
+the artifact is built in `artifact.tmp/` and renamed into `export/`
+only when complete, so a half-written artifact is never servable.
+
 Every stage and gate lands in flywheel_manifest.json next to the
-artifact, so `dctpu serve`'s baked-lever mismatch checks have a
-provenance record to point at.
+artifact (same atomic writer as the journal), so `dctpu serve`'s
+baked-lever mismatch checks have a provenance record to point at. On
+resume, gates are re-verified from the journal — enforced on every
+run, measured exactly once.
 """
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
-from typing import Dict, List, Optional, Sequence
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import ml_collections
 import numpy as np
 
 from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu import obs as obs_lib
 from deepconsensus_tpu.calibration import lib as calibration_lib
 from deepconsensus_tpu.models import checkpoints as checkpoints_lib
 from deepconsensus_tpu.models import config as config_lib
@@ -40,12 +65,326 @@ from deepconsensus_tpu.models import quantize as quantize_lib
 from deepconsensus_tpu.models import train as train_lib
 from deepconsensus_tpu.ops import output_plane
 
-MANIFEST_NAME = 'flywheel_manifest.json'
+log = logging.getLogger(__name__)
 
-# Gate thresholds mirror the acceptance tests; keep in sync with
-# tests/test_quantized_inference.py (0.002 identity, MAX_QV_DELTA=3).
-INT8_IDENTITY_GATE = 0.002
-BF16_QV_GATE = 3
+MANIFEST_NAME = 'flywheel_manifest.json'
+JOURNAL_NAME = 'flywheel_journal.json'
+# Bumped whenever a stage's journal entry shape changes incompatibly;
+# a resume across versions raises FlywheelResumeError instead of
+# misreading old entries.
+JOURNAL_SCHEMA_VERSION = 1
+MANIFEST_SCHEMA_VERSION = 2
+STAGE_ORDER = ('train', 'distill', 'gates', 'export')
+# Export staging directory: export_model writes here, and the complete
+# tree is renamed to export/ in one atomic publish step.
+EXPORT_STAGING = 'artifact.tmp'
+
+# Gate thresholds live in models/config.py — the ONE shared home the
+# acceptance tests import too, so runtime gate and test can never
+# drift. Re-exported here for compatibility.
+INT8_IDENTITY_GATE = config_lib.INT8_IDENTITY_GATE
+BF16_QV_GATE = config_lib.BF16_QV_GATE
+
+_UNSET = object()
+
+
+# ----------------------------------------------------------------------
+# Atomic JSON commits: the journal and the manifest share one writer.
+
+
+def atomic_write_json(path: str, obj: Dict) -> str:
+  """tmp + fsync + rename: readers see the old file or the new file,
+  never a torn write — a SIGKILL mid-commit leaves at worst a stale
+  .tmp next to an intact previous version. The tmp name is per-process
+  so elastic hosts sharing one out_dir can't rename each other's
+  half-written tmp out from under them."""
+  tmp = f'{path}.tmp.{os.getpid()}'
+  with open(tmp, 'w') as f:
+    json.dump(obj, f, indent=2, sort_keys=True)
+    f.write('\n')
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+  return path
+
+
+def _write_manifest(out_dir: str, manifest: Dict) -> str:
+  manifest.setdefault('schema_version', MANIFEST_SCHEMA_VERSION)
+  return atomic_write_json(os.path.join(out_dir, MANIFEST_NAME), manifest)
+
+
+def _inputs_digest(inputs: Dict) -> str:
+  blob = json.dumps(inputs, sort_keys=True).encode()
+  return hashlib.sha256(blob).hexdigest()[:16]
+
+
+# ----------------------------------------------------------------------
+# The stage journal.
+
+
+class FlywheelJournal:
+  """Per-stage durable record under <out_dir>/flywheel_journal.json.
+
+  Mutations happen in memory; commit() writes the whole journal
+  atomically. The orchestrator commits at every status transition, so
+  the on-disk journal always describes a consistent resume point."""
+
+  def __init__(self, out_dir: str):
+    self.out_dir = out_dir
+    self.path = os.path.join(out_dir, JOURNAL_NAME)
+    self.data: Dict = {
+        'schema_version': JOURNAL_SCHEMA_VERSION,
+        'stages': {},
+    }
+
+  def load(self) -> bool:
+    """Adopts an existing journal (resume). False when none exists —
+    --resume on a fresh out_dir is just a fresh run."""
+    if not os.path.exists(self.path):
+      return False
+    with open(self.path) as f:
+      data = json.load(f)
+    version = data.get('schema_version')
+    if version != JOURNAL_SCHEMA_VERSION:
+      raise faults_lib.FlywheelResumeError(
+          'schema_version', version, JOURNAL_SCHEMA_VERSION)
+    data.setdefault('stages', {})
+    self.data = data
+    return True
+
+  def commit(self) -> str:
+    return atomic_write_json(self.path, self.data)
+
+  def entry(self, stage: str) -> Optional[Dict]:
+    return self.data['stages'].get(stage)
+
+  def begin(self, stage: str, inputs: Dict, status: str = 'running',
+            n_resumes: int = 0) -> Dict:
+    prev = self.data['stages'].get(stage) or {}
+    entry = {
+        'status': status,
+        'inputs': inputs,
+        'inputs_digest': _inputs_digest(inputs),
+        'outputs': {},
+        'n_retries': int(prev.get('n_retries', 0) or 0),
+        'n_resumes': n_resumes,
+        'started': time.time(),
+        'finished': None,
+    }
+    self.data['stages'][stage] = entry
+    return entry
+
+  def finish(self, stage: str, outputs: Dict) -> None:
+    entry = self.data['stages'][stage]
+    entry['status'] = 'done'
+    entry['outputs'] = outputs
+    entry['finished'] = time.time()
+
+  def fail(self, stage: str, error: str) -> None:
+    entry = self.data['stages'].setdefault(stage, {'inputs': {}})
+    entry['status'] = 'failed'
+    entry['error'] = error
+    entry['finished'] = time.time()
+
+  def interrupt(self, stage: str, outputs: Optional[Dict] = None) -> None:
+    entry = self.data['stages'].setdefault(stage, {'inputs': {}})
+    entry['status'] = 'interrupted'
+    if outputs is not None:
+      entry['outputs'] = outputs
+    entry['finished'] = time.time()
+
+  def note_retry(self, stage: str) -> None:
+    entry = self.data['stages'].setdefault(stage, {'inputs': {}})
+    entry['n_retries'] = int(entry.get('n_retries', 0) or 0) + 1
+
+  def counters(self) -> Dict[str, int]:
+    retries = resumes = 0
+    for entry in self.data['stages'].values():
+      retries += int(entry.get('n_retries', 0) or 0)
+      resumes += int(entry.get('n_resumes', 0) or 0)
+    return {'n_stage_retries': retries, 'n_stage_resumes': resumes}
+
+
+# ----------------------------------------------------------------------
+# The Stage abstraction + the durable orchestrator core.
+
+
+class Stage:
+  """One durable flywheel stage.
+
+  inputs is the exact JSON-serializable record of everything the
+  stage's outputs depend on: matching inputs are what make a journaled
+  `done` entry skippable on resume, and a mismatch is what makes the
+  journal stale (FlywheelResumeError). run() does the work and returns
+  the outputs inventory; a truthy outputs['preempted'] tells the
+  orchestrator the stage checkpointed and stopped at a preemption
+  signal. outputs_valid re-validates a journaled outputs inventory
+  against disk (checkpoints may have been quarantined since).
+  progress, when given, is the stage's resume marker — the crash-loop
+  breaker only counts retries that fail to advance it. on_transient
+  runs before each retry (the elastic degrade hook)."""
+
+  def __init__(self, name: str, inputs: Dict,
+               run: Callable[[], Dict],
+               outputs_valid: Optional[Callable[[Dict], bool]] = None,
+               progress: Optional[Callable[[], Any]] = None,
+               on_transient: Optional[Callable[[Exception], None]] = None,
+               retryable: bool = True):
+    self.name = name
+    self.inputs = inputs
+    self.run = run
+    self.outputs_valid = outputs_valid or (lambda outputs: True)
+    self.progress = progress
+    self.on_transient = on_transient
+    self.retryable = retryable
+
+
+def _check_inputs(stage: Stage, entry: Dict) -> None:
+  """Stale-journal guard: a resumed invocation must present the same
+  inputs the journal recorded, field by field."""
+  recorded = entry.get('inputs') or {}
+  for key in sorted(set(recorded) | set(stage.inputs)):
+    if recorded.get(key) != stage.inputs.get(key):
+      raise faults_lib.FlywheelResumeError(
+          key, recorded.get(key), stage.inputs.get(key), stage=stage.name)
+
+
+def _retry_stage(stage: Stage, journal: FlywheelJournal,
+                 obs: obs_lib.MetricsRegistry, *,
+                 max_retries: int = 1_000_000,
+                 backoff_base: float = 0.5,
+                 backoff_max: float = 60.0,
+                 max_stalled_restarts: int = 3,
+                 sleep: Callable[[float], None] = time.sleep) -> Dict:
+  """run_training_with_retry semantics at stage granularity: only
+  TRANSIENT errors retry, exponential backoff between attempts, and a
+  crash-loop breaker when the stage's progress marker stops advancing
+  across max_stalled_restarts consecutive restarts."""
+  attempts = 0
+  stalled = 0
+  last = _UNSET
+  while True:
+    try:
+      return stage.run()
+    except Exception as e:  # pylint: disable=broad-except
+      message = f'{type(e).__name__}: {e}'
+      if (not stage.retryable
+          or faults_lib.classify_error(message)
+          != faults_lib.FaultKind.TRANSIENT):
+        raise
+      attempts += 1
+      if attempts > max_retries:
+        raise
+      progress = stage.progress() if stage.progress is not None else None
+      if last is not _UNSET and progress == last:
+        stalled += 1
+        if stalled >= max_stalled_restarts:
+          raise faults_lib.CrashLoopError(
+              f'flywheel stage {stage.name!r} failed {stalled + 1} '
+              f'consecutive time(s) without its progress marker '
+              f'advancing past {progress!r}; aborting instead of '
+              f'crash-looping (last error: {message.splitlines()[0]})'
+          ) from e
+      else:
+        stalled = 0
+      last = progress
+      if stage.on_transient is not None:
+        stage.on_transient(e)
+      obs.inc('n_stage_retries')
+      journal.note_retry(stage.name)
+      journal.commit()
+      delay = min(backoff_max, backoff_base * (2 ** (attempts - 1)))
+      log.warning(
+          'flywheel stage %r: transient failure (%s); retrying in '
+          '%.1fs (attempt %d)', stage.name,
+          message.splitlines()[0], delay, attempts,
+      )
+      sleep(delay)
+
+
+def _run_stages(stage_factories: Sequence[Callable[[Dict], Stage]],
+                journal: FlywheelJournal,
+                guard,
+                obs: obs_lib.MetricsRegistry,
+                *,
+                resume: bool = False,
+                results: Optional[Dict[str, Dict]] = None,
+                retry_opts: Optional[Dict] = None,
+                ) -> Tuple[Dict[str, Dict], Optional[str]]:
+  """Runs stages in order against the journal. Returns (results,
+  interrupted_stage). Each factory receives the results of every
+  earlier stage (later stages derive their inputs — e.g. checkpoint
+  paths — from them)."""
+  results = dict(results or {})
+  opts = dict(retry_opts or {})
+  for factory in stage_factories:
+    stage = factory(results)
+    entry = journal.entry(stage.name)
+    if resume and entry is not None and entry.get('inputs'):
+      _check_inputs(stage, entry)
+    if (resume and entry is not None and entry.get('status') == 'done'
+        and stage.outputs_valid(entry.get('outputs') or {})):
+      results[stage.name] = entry.get('outputs') or {}
+      obs.inc('n_stage_skips')
+      log.info('flywheel: stage %r already done (journal); skipping',
+               stage.name)
+      continue
+    if guard.local():
+      # Preempted between stages: record where the cycle stops so
+      # --resume re-enters exactly here.
+      journal.begin(stage.name, stage.inputs, status='interrupted')
+      journal.commit()
+      return results, stage.name
+    n_resumes = 0
+    if entry is not None:
+      n_resumes = int(entry.get('n_resumes', 0) or 0) + 1
+      obs.inc('n_stage_resumes')
+      log.warning('flywheel: re-entering stage %r (journal status %r)',
+                  stage.name, entry.get('status'))
+    journal.begin(stage.name, stage.inputs, n_resumes=n_resumes)
+    journal.commit()
+    # The stage-boundary drill hook: the `running` entry above is
+    # already durable, so a SIGKILL here is the worst-timed crash.
+    faults_lib.maybe_kill_flywheel_at_stage(stage.name)
+    t0 = time.time()
+    try:
+      outputs = _retry_stage(stage, journal, obs, **opts)
+    except BaseException as e:
+      journal.fail(stage.name, f'{type(e).__name__}: {e}')
+      journal.commit()
+      obs_lib.trace.complete_event(
+          'flywheel_stage', 'flywheel', t0, time.time(),
+          {'stage': stage.name, 'status': 'failed'})
+      if isinstance(e, (ValueError, KeyboardInterrupt,
+                        faults_lib.FlywheelGateError,
+                        faults_lib.FlywheelStageError,
+                        faults_lib.CrashLoopError)):
+        raise
+      if isinstance(e, Exception):
+        raise faults_lib.FlywheelStageError(
+            stage.name, f'{type(e).__name__}: {e}') from e
+      raise
+    t1 = time.time()
+    if outputs.get('preempted'):
+      journal.interrupt(stage.name, outputs)
+      journal.commit()
+      obs_lib.trace.complete_event(
+          'flywheel_stage', 'flywheel', t0, t1,
+          {'stage': stage.name, 'status': 'interrupted'})
+      results[stage.name] = outputs
+      return results, stage.name
+    journal.finish(stage.name, outputs)
+    journal.commit()
+    obs_lib.trace.complete_event(
+        'flywheel_stage', 'flywheel', t0, t1,
+        {'stage': stage.name, 'status': 'done',
+         'n_retries': int(journal.entry(stage.name).get('n_retries', 0))})
+    results[stage.name] = outputs
+  return results, None
+
+
+# ----------------------------------------------------------------------
+# Quantization gates (unchanged semantics; thresholds from config).
 
 
 def _with_levers(params: ml_collections.ConfigDict,
@@ -148,6 +487,31 @@ def _enforce(gates: Sequence[Dict]) -> None:
           detail=json.dumps(gate.get('detail', {})))
 
 
+# ----------------------------------------------------------------------
+# The flywheel driver.
+
+
+def _build_manifest(results: Dict[str, Dict], journal: FlywheelJournal,
+                    interrupted: Optional[str] = None) -> Dict:
+  manifest: Dict = {
+      'schema_version': MANIFEST_SCHEMA_VERSION,
+      'stages': {},
+      'gates': [],
+      'ok': False,
+      'counters': journal.counters(),
+  }
+  for name in ('train', 'distill', 'export'):
+    if name in results:
+      manifest['stages'][name] = results[name]
+  gates = (results.get('gates') or {}).get('gates') or []
+  manifest['gates'] = gates
+  manifest['ok'] = bool(
+      gates and all(g['passed'] for g in gates) and 'export' in results)
+  if interrupted is not None:
+    manifest['interrupted'] = interrupted
+  return manifest
+
+
 def run_flywheel(
     out_dir: str,
     train_patterns: Sequence[str],
@@ -165,6 +529,8 @@ def run_flywheel(
     int8_gate_threshold: float = INT8_IDENTITY_GATE,
     bf16_gate_threshold: int = BF16_QV_GATE,
     mesh=None,
+    resume: bool = False,
+    elastic_config: Optional[Dict] = None,
 ) -> Dict:
   """Train -> distill -> gates -> export; returns the manifest dict.
 
@@ -174,121 +540,277 @@ def run_flywheel(
   into the exported artifact; both gates run and are enforced
   regardless, so the manifest always records the full quantization
   safety envelope of the released weights.
+
+  resume=True adopts <out_dir>/flywheel_journal.json: completed stages
+  are skipped (after validating their recorded inputs against this
+  invocation — FlywheelResumeError on drift), the in-flight stage is
+  re-entered. elastic_config (host_id, n_hosts, barrier_timeout,
+  on_host_error, readmit — the `dctpu train --elastic` shape) runs the
+  train and distill stages under the PR-18 pod protocol; a
+  HostLostError that escapes the pod's own rebuild degrades the pod by
+  one host at the stage retry instead of killing the cycle.
+
+  A preemption signal (SIGTERM/SIGINT) mid-cycle checkpoints the
+  running stage where supported, marks the journal `interrupted`, and
+  returns a manifest with manifest['interrupted'] = <stage> — the
+  caller exits cleanly and `--resume` picks the cycle back up.
   """
   from deepconsensus_tpu import cli as cli_lib
 
+  out_dir = os.path.abspath(out_dir)
   os.makedirs(out_dir, exist_ok=True)
-  manifest: Dict = {'stages': {}, 'gates': [], 'ok': False}
+  obs_lib.trace.configure_from_env(tier='flywheel')
+  obs = obs_lib.MetricsRegistry(tier='flywheel')
+  journal = FlywheelJournal(out_dir)
+  if resume:
+    journal.load()
+  journal.commit()
+  elastic = dict(elastic_config) if elastic_config else None
+  barrier_timeout = float(
+      (elastic or {}).get('barrier_timeout', 30.0) or 30.0)
+  guard = train_lib.PreemptionGuard(
+      barrier_timeout=barrier_timeout).install()
 
-  # ---- stage 1: teacher ----------------------------------------------
-  if teacher_checkpoint is None:
-    teacher_params = config_lib.get_config(teacher_config)
-    cli_lib._apply_overrides(teacher_params, list(teacher_overrides))
-    config_lib.finalize_params(teacher_params)
-    with teacher_params.unlocked():
-      if batch_size:
-        teacher_params.batch_size = batch_size
-    teacher_dir = os.path.join(out_dir, 'teacher')
-    train_metrics = train_lib.run_training_with_retry(
-        params=teacher_params,
-        out_dir=teacher_dir,
-        train_patterns=list(train_patterns),
-        eval_patterns=list(eval_patterns),
-        num_epochs=num_epochs,
-        mesh=mesh,
-    )
-    teacher_checkpoint = checkpoints_lib.latest_valid_checkpoint(
-        os.path.join(teacher_dir, 'checkpoints'))
-    if teacher_checkpoint is None:
-      raise faults_lib.FlywheelGateError(
-          'teacher_training', 'no valid checkpoint', 'one checkpoint',
-          detail=f'training under {teacher_dir} left no valid checkpoint')
-    manifest['stages']['train'] = {
-        'checkpoint': teacher_checkpoint,
-        'metrics': {k: float(v) for k, v in train_metrics.items()},
-    }
-  else:
-    teacher_params = config_lib.read_params_from_json(teacher_checkpoint)
-    config_lib.finalize_params(teacher_params)
-    manifest['stages']['train'] = {
-        'checkpoint': teacher_checkpoint, 'skipped': True,
-    }
-  teacher_weights = checkpoints_lib.load_params(teacher_checkpoint)
-
-  # ---- stage 2: distill ----------------------------------------------
-  student_params = config_lib.get_config(student_config)
-  cli_lib._apply_overrides(student_params, list(student_overrides))
-  config_lib.finalize_params(student_params)
-  with student_params.unlocked():
-    if batch_size:
-      student_params.batch_size = batch_size
+  teacher_dir = os.path.join(out_dir, 'teacher')
   student_dir = os.path.join(out_dir, 'student')
-  distill_metrics = distill_lib.run_distillation(
-      params=student_params,
-      teacher_params_cfg=teacher_params,
-      teacher_variables={'params': teacher_weights},
-      out_dir=student_dir,
-      train_patterns=list(train_patterns),
-      eval_patterns=list(eval_patterns),
-      num_epochs=num_epochs,
-      mesh=mesh,
-  )
-  student_checkpoint = checkpoints_lib.latest_valid_checkpoint(
-      os.path.join(student_dir, 'checkpoints'))
-  if student_checkpoint is None:
-    raise faults_lib.FlywheelGateError(
-        'distillation', 'no valid checkpoint', 'one checkpoint',
-        detail=f'distillation under {student_dir} left no valid checkpoint')
-  manifest['stages']['distill'] = {
-      'checkpoint': student_checkpoint,
-      'metrics': {k: float(v) for k, v in distill_metrics.items()},
-  }
-  student_variables = {'params': checkpoints_lib.load_params(
-      student_checkpoint)}
-
-  # ---- stage 3: quantization gates -----------------------------------
   gates_dir = os.path.join(out_dir, 'gates')
-  gates: List[Dict] = [
-      int8_identity_gate(student_params, student_variables,
-                         list(eval_patterns), gates_dir,
-                         threshold=int8_gate_threshold),
-      bf16_qv_gate(student_params, student_variables,
-                   list(eval_patterns), threshold=bf16_gate_threshold),
-  ]
-  manifest['gates'] = gates
-  # Manifest lands even on a failed gate: the failure itself is the
-  # record the next flywheel turn starts from.
-  _write_manifest(out_dir, manifest)
-  _enforce(gates)
 
-  # ---- stage 4: export -----------------------------------------------
-  export_dir = os.path.join(out_dir, 'export')
-  artifact = export_lib.export_model(
-      checkpoint_path=student_checkpoint,
-      out_dir=export_dir,
-      batch_size=export_batch_size,
-      variables=student_variables,
-      params=student_params,
-      inference_dtype=inference_dtype,
-      quantize_matmuls=quantize_matmuls,
-  )
-  manifest['stages']['export'] = {
-      'artifact': artifact,
-      'baked_levers': {
-          'inference_dtype': inference_dtype or 'float32',
-          'quantize_matmuls': quantize_matmuls or 'none',
-      },
-  }
-  manifest['ok'] = all(g['passed'] for g in gates)
-  _write_manifest(out_dir, manifest)
-  return manifest
+  def _teacher_params():
+    p = config_lib.get_config(teacher_config)
+    cli_lib._apply_overrides(p, list(teacher_overrides))
+    config_lib.finalize_params(p)
+    with p.unlocked():
+      if batch_size:
+        p.batch_size = batch_size
+    return p
 
+  def _student_params():
+    p = config_lib.get_config(student_config)
+    cli_lib._apply_overrides(p, list(student_overrides))
+    config_lib.finalize_params(p)
+    with p.unlocked():
+      if batch_size:
+        p.batch_size = batch_size
+    return p
 
-def _write_manifest(out_dir: str, manifest: Dict) -> str:
-  path = os.path.join(out_dir, MANIFEST_NAME)
-  tmp = path + '.tmp'
-  with open(tmp, 'w') as f:
-    json.dump(manifest, f, indent=2, sort_keys=True)
-    f.write('\n')
-  os.replace(tmp, path)
-  return path
+  def _degrade_pod(err: Exception) -> None:
+    """Stage-retry hook: a HostLostError that escaped the pod's own
+    rebuild means the lost host is not coming back inside the retry
+    window — shrink the expected membership so the retried stage forms
+    a smaller pod instead of waiting on the dead host forever."""
+    if not isinstance(err, faults_lib.HostLostError):
+      return
+    if elastic and int(elastic.get('n_hosts', 1) or 1) > 1:
+      elastic['n_hosts'] = int(elastic['n_hosts']) - 1
+      log.warning(
+          'flywheel: degrading pod to %d host(s) after %s',
+          elastic['n_hosts'], str(err).splitlines()[0])
+
+  def _metrics_of(metrics: Dict) -> Dict[str, float]:
+    return {k: float(v) for k, v in metrics.items()}
+
+  # ---- stage factories -------------------------------------------------
+
+  def _train_stage(results: Dict[str, Dict]) -> Stage:
+    del results
+    inputs = {
+        'teacher_config': teacher_config,
+        'teacher_overrides': list(teacher_overrides),
+        'teacher_checkpoint': teacher_checkpoint or '',
+        'batch_size': int(batch_size or 0),
+        'num_epochs': int(num_epochs or 0),
+        'train_patterns': list(train_patterns),
+        'eval_patterns': list(eval_patterns),
+    }
+
+    def run() -> Dict:
+      if teacher_checkpoint:
+        if not os.path.exists(teacher_checkpoint):
+          raise FileNotFoundError(
+              f'--teacher_checkpoint {teacher_checkpoint!r} does not '
+              'exist')
+        return {'checkpoint': teacher_checkpoint, 'skipped': True}
+      metrics = train_lib.run_training(
+          params=_teacher_params(),
+          out_dir=teacher_dir,
+          train_patterns=list(train_patterns),
+          eval_patterns=list(eval_patterns),
+          num_epochs=num_epochs,
+          mesh=mesh,
+          elastic_config=elastic,
+          preemption_guard=guard,
+      )
+      ckpt = checkpoints_lib.latest_valid_checkpoint(
+          os.path.join(teacher_dir, 'checkpoints'))
+      if metrics.get('preempted'):
+        return {'preempted': True,
+                'stop_step': float(metrics.get('stop_step', 0.0)),
+                'checkpoint': ckpt or ''}
+      if ckpt is None:
+        raise faults_lib.FlywheelStageError(
+            'train',
+            f'training under {teacher_dir} left no valid checkpoint')
+      return {'checkpoint': ckpt, 'metrics': _metrics_of(metrics)}
+
+    def outputs_valid(outputs: Dict) -> bool:
+      ckpt = outputs.get('checkpoint')
+      if not ckpt:
+        return False
+      if outputs.get('skipped'):
+        return os.path.exists(ckpt)
+      return checkpoints_lib.validate_checkpoint(ckpt)[0]
+
+    return Stage(
+        'train', inputs, run, outputs_valid=outputs_valid,
+        progress=lambda: checkpoints_lib.latest_valid_step(
+            os.path.join(teacher_dir, 'checkpoints')),
+        on_transient=_degrade_pod)
+
+  def _distill_stage(results: Dict[str, Dict]) -> Stage:
+    teacher_ckpt = results['train']['checkpoint']
+    inputs = {
+        'student_config': student_config,
+        'student_overrides': list(student_overrides),
+        'batch_size': int(batch_size or 0),
+        'num_epochs': int(num_epochs or 0),
+        'train_patterns': list(train_patterns),
+        'eval_patterns': list(eval_patterns),
+        'teacher_checkpoint': teacher_ckpt,
+    }
+
+    def run() -> Dict:
+      teacher_params = config_lib.read_params_from_json(teacher_ckpt)
+      config_lib.finalize_params(teacher_params)
+      teacher_weights = checkpoints_lib.load_params(teacher_ckpt)
+      metrics = distill_lib.run_distillation(
+          params=_student_params(),
+          teacher_params_cfg=teacher_params,
+          teacher_variables={'params': teacher_weights},
+          out_dir=student_dir,
+          train_patterns=list(train_patterns),
+          eval_patterns=list(eval_patterns),
+          num_epochs=num_epochs,
+          mesh=mesh,
+          elastic_config=elastic,
+          preemption_guard=guard,
+      )
+      ckpt = checkpoints_lib.latest_valid_checkpoint(
+          os.path.join(student_dir, 'checkpoints'))
+      if metrics.get('preempted'):
+        return {'preempted': True,
+                'stop_step': float(metrics.get('stop_step', 0.0)),
+                'checkpoint': ckpt or ''}
+      if ckpt is None:
+        raise faults_lib.FlywheelStageError(
+            'distill',
+            f'distillation under {student_dir} left no valid checkpoint')
+      return {'checkpoint': ckpt, 'metrics': _metrics_of(metrics)}
+
+    def outputs_valid(outputs: Dict) -> bool:
+      ckpt = outputs.get('checkpoint')
+      return bool(ckpt) and checkpoints_lib.validate_checkpoint(ckpt)[0]
+
+    return Stage(
+        'distill', inputs, run, outputs_valid=outputs_valid,
+        progress=lambda: checkpoints_lib.latest_valid_step(
+            os.path.join(student_dir, 'checkpoints')),
+        on_transient=_degrade_pod)
+
+  def _gates_stage(results: Dict[str, Dict]) -> Stage:
+    student_ckpt = results['distill']['checkpoint']
+    inputs = {
+        'student_config': student_config,
+        'student_overrides': list(student_overrides),
+        'batch_size': int(batch_size or 0),
+        'int8_gate_threshold': float(int8_gate_threshold),
+        'bf16_gate_threshold': int(bf16_gate_threshold),
+        'eval_patterns': list(eval_patterns),
+        'checkpoint': student_ckpt,
+    }
+
+    def run() -> Dict:
+      student_params = _student_params()
+      variables = {'params': checkpoints_lib.load_params(student_ckpt)}
+      gates: List[Dict] = [
+          int8_identity_gate(student_params, variables,
+                             list(eval_patterns), gates_dir,
+                             threshold=int8_gate_threshold),
+          bf16_qv_gate(student_params, variables,
+                       list(eval_patterns),
+                       threshold=bf16_gate_threshold),
+      ]
+      return {'gates': gates}
+
+    return Stage('gates', inputs, run)
+
+  def _export_stage(results: Dict[str, Dict]) -> Stage:
+    student_ckpt = results['distill']['checkpoint']
+    inputs = {
+        'export_batch_size': int(export_batch_size),
+        'inference_dtype': inference_dtype or '',
+        'quantize_matmuls': quantize_matmuls or '',
+        'checkpoint': student_ckpt,
+    }
+
+    def run() -> Dict:
+      student_params = _student_params()
+      variables = {'params': checkpoints_lib.load_params(student_ckpt)}
+      staging = os.path.join(out_dir, EXPORT_STAGING)
+      final = os.path.join(out_dir, 'export')
+      if os.path.isdir(staging):
+        # Idempotent re-entry: a half-finished staging tree from a
+        # killed export is rebuilt from scratch, never patched.
+        shutil.rmtree(staging)
+      artifact = export_lib.export_model(
+          checkpoint_path=student_ckpt,
+          out_dir=staging,
+          batch_size=export_batch_size,
+          variables=variables,
+          params=student_params,
+          inference_dtype=inference_dtype,
+          quantize_matmuls=quantize_matmuls,
+      )
+      if os.path.isdir(final):
+        # The journal does not say `done` (we are running), so
+        # anything at the final path is wreckage from an interrupted
+        # publish — replace it.
+        shutil.rmtree(final)
+      os.replace(staging, final)
+      return {
+          'artifact': os.path.join(final, os.path.basename(artifact)),
+          'baked_levers': {
+              'inference_dtype': inference_dtype or 'float32',
+              'quantize_matmuls': quantize_matmuls or 'none',
+          },
+      }
+
+    def outputs_valid(outputs: Dict) -> bool:
+      artifact = outputs.get('artifact')
+      return bool(artifact) and os.path.exists(artifact)
+
+    return Stage('export', inputs, run, outputs_valid=outputs_valid)
+
+  # ---- orchestration ---------------------------------------------------
+
+  try:
+    results, interrupted = _run_stages(
+        [_train_stage, _distill_stage, _gates_stage],
+        journal, guard, obs, resume=resume)
+    if interrupted is not None:
+      manifest = _build_manifest(results, journal, interrupted=interrupted)
+      _write_manifest(out_dir, manifest)
+      return manifest
+    # Manifest lands even on a failed gate: the failure itself is the
+    # record the next flywheel turn starts from. On resume the gates
+    # come straight from the journal — measured once, enforced always.
+    manifest = _build_manifest(results, journal)
+    _write_manifest(out_dir, manifest)
+    _enforce(results['gates']['gates'])
+    results, interrupted = _run_stages(
+        [_export_stage], journal, guard, obs,
+        resume=resume, results=results)
+    manifest = _build_manifest(results, journal, interrupted=interrupted)
+    _write_manifest(out_dir, manifest)
+    return manifest
+  finally:
+    guard.restore()

@@ -1087,6 +1087,7 @@ def run_training(
     warm_start: Optional[str] = None,
     distributed_config: Optional[Dict[str, Any]] = None,
     elastic_config: Optional[Dict[str, Any]] = None,
+    preemption_guard: Optional['PreemptionGuard'] = None,
 ) -> Dict[str, float]:
   """End-to-end training driver. Returns final eval metrics.
 
@@ -1321,7 +1322,11 @@ def run_training(
         for b in train_batches()
     )
 
-  guard = PreemptionGuard(
+  # An orchestrator (models/flywheel.py) that owns the process-wide
+  # signal handlers passes its guard in; we only install (and later
+  # restore) our own when running standalone.
+  owns_guard = preemption_guard is None
+  guard = preemption_guard or PreemptionGuard(
       barrier_timeout=float(
           params.get('elastic_barrier_timeout', 30.0) or 30.0)
   ).install()
@@ -1738,7 +1743,8 @@ def run_training(
   finally:
     if prefetcher is not None:
       prefetcher.close()
-    guard.restore()
+    if owns_guard:
+      guard.restore()
     sentinel.close()
     fault_counters: Dict[str, float] = dict(sentinel.counters)
     if pod is not None:

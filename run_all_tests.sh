@@ -30,6 +30,14 @@
 #                                  # re-admission, and the subprocess
 #                                  # SIGKILL drill through the CLI
 #                                  # (slow, included in this mode)
+#   ./run_all_tests.sh flywheel    # flywheel durability only: stage
+#                                  # journal round-trip, --resume
+#                                  # skip/re-entry semantics, stale-
+#                                  # journal rejection, stage retries
+#                                  # + crash-loop breaker, and the
+#                                  # subprocess SIGKILL-at-every-
+#                                  # stage-boundary drill (slow,
+#                                  # included in this mode)
 #   ./run_all_tests.sh fleet       # fleet tier only: `dctpu route`
 #                                  # balancing/retry semantics,
 #                                  # featurize workers, protocol
@@ -108,6 +116,10 @@ fi
 
 if [[ "${1:-}" == "elastic" ]]; then
   exec scripts/run_resilience.sh --elastic
+fi
+
+if [[ "${1:-}" == "flywheel" ]]; then
+  exec scripts/run_resilience.sh --flywheel
 fi
 
 if [[ "${1:-}" == "fleet" ]]; then

@@ -502,6 +502,12 @@ def main(argv: Optional[List[str]] = None) -> int:
           '  DCTPU_FAULT_HOST_REJOIN_AT_STEP=N a restarted host '
           'defers its join request until the pod reaches step N '
           '(1-based) — paces re-admission drills\n'
+          '  DCTPU_FAULT_FLYWHEEL_KILL_AT_STAGE=<train|distill|gates|'
+          'export>  SIGKILL `dctpu flywheel` right after the named '
+          'stage commits its `running` journal entry — the '
+          'worst-timed stage-boundary crash (consume-once per '
+          'process; honors DCTPU_FAULT_KILL_TOKEN so a --resume '
+          'rerun under the same env completes)\n'
       ),
   )
   sub = parser.add_subparsers(dest='command', required=True)
@@ -598,6 +604,21 @@ def main(argv: Optional[List[str]] = None) -> int:
   p.add_argument('--rejoin_at_step', type=int, default=None,
                  help='Defer a restarted host\'s join request until '
                  'the pod reaches this 1-based step.')
+  p.add_argument('cmd', nargs=argparse.REMAINDER,
+                 help='Command to exec with the hook armed; without '
+                 'one, print the env assignments to eval.')
+
+  p = sub.add_parser('flywheel',
+                     help='Arm the flywheel stage-boundary kill hook '
+                     '(SIGKILL right after the named stage commits '
+                     'its `running` journal entry) and optionally '
+                     'exec a command under it.')
+  p.add_argument('--kill_at_stage', required=True,
+                 choices=('train', 'distill', 'gates', 'export'))
+  p.add_argument('--kill_token', default=None,
+                 help='Token file path: the kill fires only once '
+                 'across restarts, so a --resume rerun under the '
+                 'same env completes.')
   p.add_argument('cmd', nargs=argparse.REMAINDER,
                  help='Command to exec with the hook armed; without '
                  'one, print the env assignments to eval.')
@@ -704,6 +725,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         env[faults_lib.ENV_HOST_LOST_MODE] = args.mode
     if args.rejoin_at_step is not None:
       env[faults_lib.ENV_HOST_REJOIN_AT_STEP] = str(args.rejoin_at_step)
+    cmd = [c for c in args.cmd if c != '--']
+    if not cmd:
+      for key, value in env.items():
+        print(f'export {key}={value}')
+      return 0
+    os.environ.update(env)
+    os.execvp(cmd[0], cmd)
+
+  if args.command == 'flywheel':
+    from deepconsensus_tpu import faults as faults_lib
+
+    env = {faults_lib.ENV_FLYWHEEL_KILL_AT_STAGE: args.kill_at_stage}
+    if args.kill_token:
+      env[faults_lib.ENV_KILL_TOKEN] = args.kill_token
     cmd = [c for c in args.cmd if c != '--']
     if not cmd:
       for key, value in env.items():

@@ -34,6 +34,15 @@
 #                                         # plus the real subprocess
 #                                         # SIGKILL drill through the
 #                                         # CLI (slow, included here)
+#   scripts/run_resilience.sh --flywheel  # flywheel durability only:
+#                                         # journal round-trip, resume
+#                                         # skip/re-entry, stale-journal
+#                                         # rejection, stage retries +
+#                                         # breaker, plus the slow
+#                                         # subprocess SIGKILL-at-every-
+#                                         # stage-boundary drill through
+#                                         # the CLI (--resume completes
+#                                         # each killed cycle)
 #   scripts/run_resilience.sh --fleet     # fleet tier only: `dctpu
 #                                         # route` balancing + retry
 #                                         # semantics, featurize
@@ -95,6 +104,20 @@ if [[ "${1:-}" == "--elastic" ]]; then
   # orbax save).
   exec timeout -k 10 1200 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_elastic.py \
+    -q --continue-on-collection-errors "$@"
+fi
+
+if [[ "${1:-}" == "--flywheel" ]]; then
+  shift
+  # The flywheel durability domain in isolation, slow drills included
+  # (the subprocess SIGKILL-per-stage drill is the ROADMAP item 3
+  # acceptance demo; each killed cycle is a real `dctpu flywheel`
+  # train->distill->gates->export on synthetic shards).
+  # DCTPU_FLYWHEEL_DRILL=1 unlocks the ~20-minute drill tests that the
+  # default resilience run (600 s budget) skips.
+  exec timeout -k 10 2400 env JAX_PLATFORMS=cpu \
+    DCTPU_FLYWHEEL_DRILL=1 \
+    python -m pytest tests/test_flywheel_resilience.py \
     -q --continue-on-collection-errors "$@"
 fi
 

@@ -1,0 +1,634 @@
+"""Durability tests for the flywheel orchestration layer.
+
+Two tiers in one file:
+
+* Fast in-process tests of the journal (atomic commits, schema
+  versioning), the `Stage` orchestrator (`--resume` skip/re-entry
+  semantics, stale-journal rejection, preemption between and inside
+  stages), and the stage-level transient retry loop with its
+  crash-loop breaker. These run everywhere the resilience marker runs.
+
+* The slow end-to-end drills — real `dctpu flywheel` subprocess
+  cycles on synthetic shards: SIGKILL at every stage boundary with
+  `--resume` completing each killed cycle (and the final artifact
+  serving byte-identically to an undisturbed cycle), gate-failure
+  resume still exiting 3 with the gates measured exactly once,
+  idempotent export re-entry, SIGTERM mid-train checkpointing +
+  resuming, and the two-host elastic cycle surviving a mid-train host
+  loss. A full drill pass costs ~20 minutes of CPU training, so these
+  are gated behind DCTPU_FLYWHEEL_DRILL=1 — `scripts/run_resilience.sh
+  --flywheel` (or `./run_all_tests.sh flywheel`) sets it.
+"""
+import glob as glob_lib
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from deepconsensus_tpu import faults as faults_lib
+from deepconsensus_tpu import obs as obs_lib
+from deepconsensus_tpu.models import flywheel as flywheel_lib
+
+pytestmark = pytest.mark.resilience
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+  sys.path.insert(0, _REPO_ROOT)
+
+_DRILL = pytest.mark.skipif(
+    os.environ.get('DCTPU_FLYWHEEL_DRILL') != '1',
+    reason='full flywheel drill (~20 min of CPU cycles); run '
+           'scripts/run_resilience.sh --flywheel')
+
+MAX_PASSES = 5
+MAX_LENGTH = 20
+
+
+# ----------------------------------------------------------------------
+# In-process helpers.
+
+
+def _registry() -> obs_lib.MetricsRegistry:
+  return obs_lib.MetricsRegistry(tier='test')
+
+
+def _guard(hits=()):
+  """Stand-in for PreemptionGuard: local() pops scripted answers."""
+  answers = list(hits)
+
+  def local():
+    return answers.pop(0) if answers else False
+
+  return types.SimpleNamespace(local=local)
+
+
+def _toy_stage(name, calls, outputs=None, run=None, **kwargs):
+  def default_run():
+    calls.append(name)
+    return dict(outputs or {'ok': True})
+
+  return flywheel_lib.Stage(name, {'cfg': name}, run or default_run,
+                            **kwargs)
+
+
+# ----------------------------------------------------------------------
+# Journal + atomic writer.
+
+
+def test_atomic_write_json_round_trip(tmp_path):
+  path = str(tmp_path / 'j.json')
+  flywheel_lib.atomic_write_json(path, {'a': 1})
+  flywheel_lib.atomic_write_json(path, {'a': 2})
+  with open(path) as f:
+    assert json.load(f) == {'a': 2}
+  # No leftover tmp files (the name is pid-unique so concurrent
+  # elastic hosts can't rename each other's half-written commits).
+  assert [p.name for p in tmp_path.iterdir()] == ['j.json']
+
+
+def test_journal_round_trip(tmp_path):
+  out = str(tmp_path)
+  journal = flywheel_lib.FlywheelJournal(out)
+  assert journal.load() is False  # fresh out_dir: no journal yet
+  journal.begin('train', {'x': 1})
+  journal.finish('train', {'checkpoint': '/c/1'})
+  journal.note_retry('train')
+  journal.commit()
+
+  fresh = flywheel_lib.FlywheelJournal(out)
+  assert fresh.load() is True
+  entry = fresh.entry('train')
+  assert entry['status'] == 'done'
+  assert entry['inputs'] == {'x': 1}
+  assert entry['inputs_digest'] == flywheel_lib._inputs_digest({'x': 1})
+  assert entry['outputs'] == {'checkpoint': '/c/1'}
+  assert fresh.counters() == {'n_stage_retries': 1, 'n_stage_resumes': 0}
+
+
+def test_journal_schema_mismatch_raises_typed(tmp_path):
+  out = str(tmp_path)
+  flywheel_lib.atomic_write_json(
+      os.path.join(out, flywheel_lib.JOURNAL_NAME),
+      {'schema_version': 99, 'stages': {}})
+  journal = flywheel_lib.FlywheelJournal(out)
+  with pytest.raises(faults_lib.FlywheelResumeError) as exc_info:
+    journal.load()
+  assert exc_info.value.field == 'schema_version'
+  assert exc_info.value.journal_value == 99
+  assert '--resume' in str(exc_info.value)
+
+
+def test_begin_preserves_retry_count_across_reentry(tmp_path):
+  journal = flywheel_lib.FlywheelJournal(str(tmp_path))
+  journal.begin('train', {'x': 1})
+  journal.note_retry('train')
+  journal.note_retry('train')
+  journal.begin('train', {'x': 1}, n_resumes=1)
+  entry = journal.entry('train')
+  assert entry['n_retries'] == 2
+  assert entry['n_resumes'] == 1
+
+
+# ----------------------------------------------------------------------
+# The orchestrator: skip / re-enter / stale / preempt.
+
+
+def test_resume_skips_done_stages(tmp_path):
+  out = str(tmp_path)
+  calls = []
+  factories = [lambda r: _toy_stage('a', calls, {'art': 'a1'}),
+               lambda r: _toy_stage('b', calls, {'art': 'b1'})]
+
+  journal = flywheel_lib.FlywheelJournal(out)
+  results, interrupted = flywheel_lib._run_stages(
+      factories, journal, _guard(), _registry())
+  assert interrupted is None
+  assert calls == ['a', 'b']
+
+  resumed = flywheel_lib.FlywheelJournal(out)
+  assert resumed.load()
+  obs = _registry()
+  results, interrupted = flywheel_lib._run_stages(
+      factories, resumed, _guard(), obs, resume=True)
+  assert interrupted is None
+  assert calls == ['a', 'b']  # nothing re-ran
+  assert results == {'a': {'art': 'a1'}, 'b': {'art': 'b1'}}
+  assert obs.counter_values().get('n_stage_skips') == 2
+
+
+def test_resume_reenters_inflight_stage_and_counts(tmp_path):
+  out = str(tmp_path)
+  calls = []
+  factories = [lambda r: _toy_stage('a', calls)]
+
+  # Simulate the SIGKILL-after-commit crash: a durable `running` entry.
+  journal = flywheel_lib.FlywheelJournal(out)
+  journal.begin('a', {'cfg': 'a'})
+  journal.commit()
+
+  resumed = flywheel_lib.FlywheelJournal(out)
+  assert resumed.load()
+  obs = _registry()
+  _, interrupted = flywheel_lib._run_stages(
+      factories, resumed, _guard(), obs, resume=True)
+  assert interrupted is None
+  assert calls == ['a']
+  entry = resumed.entry('a')
+  assert entry['status'] == 'done'
+  assert entry['n_resumes'] == 1
+  assert obs.counter_values().get('n_stage_resumes') == 1
+
+
+def test_stale_journal_names_mismatched_field(tmp_path):
+  out = str(tmp_path)
+  journal = flywheel_lib.FlywheelJournal(out)
+  journal.begin('a', {'cfg': 'old', 'batch': 8})
+  journal.finish('a', {'ok': True})
+  journal.commit()
+
+  resumed = flywheel_lib.FlywheelJournal(out)
+  resumed.load()
+  calls = []
+  stage = flywheel_lib.Stage('a', {'cfg': 'new', 'batch': 8},
+                             lambda: calls.append('a') or {})
+  with pytest.raises(faults_lib.FlywheelResumeError) as exc_info:
+    flywheel_lib._run_stages(
+        [lambda r: stage], resumed, _guard(), _registry(), resume=True)
+  err = exc_info.value
+  assert err.field == 'cfg'
+  assert err.journal_value == 'old'
+  assert err.current_value == 'new'
+  assert err.stage == 'a'
+  assert not calls  # rejected before any work ran
+
+
+def test_invalid_outputs_force_rerun(tmp_path):
+  out = str(tmp_path)
+  calls = []
+  journal = flywheel_lib.FlywheelJournal(out)
+  journal.begin('a', {'cfg': 'a'})
+  journal.finish('a', {'checkpoint': '/gone'})
+  journal.commit()
+
+  resumed = flywheel_lib.FlywheelJournal(out)
+  resumed.load()
+  factories = [lambda r: _toy_stage('a', calls, {'checkpoint': '/new'},
+                                    outputs_valid=lambda o: False)]
+  results, _ = flywheel_lib._run_stages(
+      factories, resumed, _guard(), _registry(), resume=True)
+  assert calls == ['a']  # quarantined outputs: the stage re-ran
+  assert results['a'] == {'checkpoint': '/new'}
+
+
+def test_preemption_between_stages_interrupts(tmp_path):
+  out = str(tmp_path)
+  calls = []
+  factories = [lambda r: _toy_stage('a', calls),
+               lambda r: _toy_stage('b', calls)]
+  journal = flywheel_lib.FlywheelJournal(out)
+  # guard goes hot after stage a completes.
+  results, interrupted = flywheel_lib._run_stages(
+      factories, journal, _guard([False, True]), _registry())
+  assert interrupted == 'b'
+  assert calls == ['a']
+  assert journal.entry('a')['status'] == 'done'
+  assert journal.entry('b')['status'] == 'interrupted'
+  assert 'b' not in results
+
+
+def test_preempted_stage_outputs_interrupt(tmp_path):
+  out = str(tmp_path)
+
+  def run():
+    return {'preempted': True, 'stop_step': 3.0, 'checkpoint': '/c/3'}
+
+  journal = flywheel_lib.FlywheelJournal(out)
+  results, interrupted = flywheel_lib._run_stages(
+      [lambda r: flywheel_lib.Stage('train', {'cfg': 't'}, run)],
+      journal, _guard(), _registry())
+  assert interrupted == 'train'
+  entry = journal.entry('train')
+  assert entry['status'] == 'interrupted'
+  assert entry['outputs']['checkpoint'] == '/c/3'
+  assert results['train']['preempted']
+
+
+# ----------------------------------------------------------------------
+# Stage retries + the crash-loop breaker.
+
+
+def test_transient_stage_failure_retries_and_journals(tmp_path):
+  journal = flywheel_lib.FlywheelJournal(str(tmp_path))
+  sleeps = []
+  degraded = []
+  attempts = {'n': 0}
+
+  def run():
+    attempts['n'] += 1
+    if attempts['n'] == 1:
+      raise RuntimeError('UNAVAILABLE: device preempted')
+    return {'ok': True}
+
+  stage = flywheel_lib.Stage(
+      'train', {'cfg': 't'}, run,
+      progress=lambda: attempts['n'],
+      on_transient=degraded.append)
+  obs = _registry()
+  results, interrupted = flywheel_lib._run_stages(
+      [lambda r: stage], journal, _guard(), obs,
+      retry_opts={'sleep': sleeps.append})
+  assert interrupted is None
+  assert results['train'] == {'ok': True}
+  assert attempts['n'] == 2
+  assert sleeps == [0.5]  # backoff_base * 2**0
+  assert len(degraded) == 1
+  assert journal.entry('train')['n_retries'] == 1
+  assert obs.counter_values().get('n_stage_retries') == 1
+
+
+def test_crash_loop_breaker_on_stalled_stage(tmp_path):
+  journal = flywheel_lib.FlywheelJournal(str(tmp_path))
+
+  def run():
+    raise RuntimeError('DEADLINE_EXCEEDED: collective timed out')
+
+  stage = flywheel_lib.Stage('distill', {'cfg': 'd'}, run,
+                             progress=lambda: 7)  # never advances
+  with pytest.raises(faults_lib.CrashLoopError) as exc_info:
+    flywheel_lib._run_stages(
+        [lambda r: stage], journal, _guard(), _registry(),
+        retry_opts={'sleep': lambda s: None, 'max_stalled_restarts': 2})
+  assert 'distill' in str(exc_info.value)
+  assert journal.entry('distill')['status'] == 'failed'
+
+
+def test_permanent_error_is_not_retried_and_is_typed(tmp_path):
+  journal = flywheel_lib.FlywheelJournal(str(tmp_path))
+  attempts = {'n': 0}
+
+  def run():
+    attempts['n'] += 1
+    raise RuntimeError('matmul dimension mismatch')
+
+  with pytest.raises(faults_lib.FlywheelStageError) as exc_info:
+    flywheel_lib._run_stages(
+        [lambda r: flywheel_lib.Stage('gates', {'cfg': 'g'}, run)],
+        journal, _guard(), _registry())
+  assert attempts['n'] == 1  # permanent: no retry
+  assert exc_info.value.stage == 'gates'
+  assert journal.entry('gates')['status'] == 'failed'
+
+
+def test_value_error_passes_through_unwrapped(tmp_path):
+  journal = flywheel_lib.FlywheelJournal(str(tmp_path))
+
+  def run():
+    raise ValueError('unknown config override')
+
+  with pytest.raises(ValueError, match='unknown config override'):
+    flywheel_lib._run_stages(
+        [lambda r: flywheel_lib.Stage('train', {'cfg': 't'}, run)],
+        journal, _guard(), _registry())
+  assert journal.entry('train')['status'] == 'failed'
+
+
+# ----------------------------------------------------------------------
+# Manifest + fault-hook plumbing.
+
+
+def test_manifest_carries_schema_version_and_counters(tmp_path):
+  out = str(tmp_path)
+  journal = flywheel_lib.FlywheelJournal(out)
+  journal.begin('train', {'x': 1}, n_resumes=2)
+  journal.finish('train', {'checkpoint': '/c'})
+  manifest = flywheel_lib._build_manifest({'train': {'checkpoint': '/c'}},
+                                          journal)
+  flywheel_lib._write_manifest(out, manifest)
+  with open(os.path.join(out, flywheel_lib.MANIFEST_NAME)) as f:
+    loaded = json.load(f)
+  assert loaded['schema_version'] == flywheel_lib.MANIFEST_SCHEMA_VERSION
+  assert loaded['counters'] == {'n_stage_retries': 0, 'n_stage_resumes': 2}
+  assert loaded['ok'] is False  # no gates, no export
+
+
+def test_gate_thresholds_come_from_config():
+  from deepconsensus_tpu.models import config as config_lib
+
+  assert flywheel_lib.INT8_IDENTITY_GATE is config_lib.INT8_IDENTITY_GATE
+  assert flywheel_lib.BF16_QV_GATE is config_lib.BF16_QV_GATE
+
+
+def test_inject_faults_flywheel_prints_env(capsys):
+  from scripts import inject_faults
+
+  assert inject_faults.main(['flywheel', '--kill_at_stage', 'distill',
+                             '--kill_token', '/tmp/tok']) == 0
+  out = capsys.readouterr().out
+  assert 'export DCTPU_FAULT_FLYWHEEL_KILL_AT_STAGE=distill' in out
+  assert 'export DCTPU_FAULT_KILL_TOKEN=/tmp/tok' in out
+
+
+def test_kill_hook_only_fires_on_named_stage(monkeypatch):
+  monkeypatch.setattr(faults_lib, '_fired', set())
+  monkeypatch.delenv(faults_lib.ENV_FLYWHEEL_KILL_AT_STAGE, raising=False)
+  # Unarmed: any stage name is a no-op (we are still alive to assert).
+  faults_lib.maybe_kill_flywheel_at_stage('train')
+  monkeypatch.setenv(faults_lib.ENV_FLYWHEEL_KILL_AT_STAGE, 'export')
+  faults_lib.maybe_kill_flywheel_at_stage('train')
+  faults_lib.maybe_kill_flywheel_at_stage('gates')
+  assert faults_lib.ENV_FLYWHEEL_KILL_AT_STAGE not in faults_lib._fired
+
+
+# ----------------------------------------------------------------------
+# The end-to-end drills (real subprocess cycles; DCTPU_FLYWHEEL_DRILL).
+
+
+@pytest.fixture(scope='module')
+def shards(tmp_path_factory):
+  from scripts import inject_faults
+
+  d = tmp_path_factory.mktemp('fw_shards')
+  inject_faults.write_synthetic_tfrecords(
+      str(d), n_shards=2, n_examples=64,
+      max_passes=MAX_PASSES, max_length=MAX_LENGTH)
+  return os.path.join(str(d), 'shard-*')
+
+
+def _flywheel_args(out_dir, shard_glob, *extra):
+  return ['--out_dir', out_dir,
+          '--train_path', shard_glob, '--eval_path', shard_glob,
+          '--batch_size', '8', '--num_epochs', '1',
+          '--export_batch_size', '8',
+          '--set', f'max_passes={MAX_PASSES}',
+          '--set', f'max_length={MAX_LENGTH}',
+          '--student_set', f'max_passes={MAX_PASSES}',
+          '--student_set', f'max_length={MAX_LENGTH}',
+          *extra]
+
+
+# The drills run real `dctpu flywheel` cycles as subprocesses. Pin
+# them to one host-platform device: conftest.py forces 8 faked CPU
+# devices into os.environ for the multichip unit tests, but the
+# flywheel recipe (docs/training.md) is a plain single-host run, and
+# the drills must reproduce the documented recipe — durability
+# semantics, not device sharding, are under test here.
+_DRILL_ENV = dict(JAX_PLATFORMS='cpu', PYTHONPATH=_REPO_ROOT,
+                  XLA_FLAGS='--xla_force_host_platform_device_count=1')
+
+
+def _run_cli(args, env_extra=None, timeout=570):
+  cmd = [sys.executable, '-m', 'deepconsensus_tpu.cli', 'flywheel'] + args
+  env = dict(os.environ, **_DRILL_ENV)
+  env.update(env_extra or {})
+  return subprocess.run(cmd, env=env, cwd=_REPO_ROOT,
+                        capture_output=True, text=True, timeout=timeout)
+
+
+def _journal_statuses(out_dir):
+  with open(os.path.join(out_dir, flywheel_lib.JOURNAL_NAME)) as f:
+    journal = json.load(f)
+  return {name: entry['status']
+          for name, entry in journal['stages'].items()}, journal
+
+
+def _served_planes(export_dir):
+  from deepconsensus_tpu.inference import runner as runner_lib
+
+  rng = np.random.RandomState(0)
+  rows = rng.uniform(0.0, 10.0, size=(
+      8, 4 * MAX_PASSES + 5, MAX_LENGTH, 1)).astype(np.float32)
+  runner = runner_lib.ModelRunner.from_exported(
+      export_dir, runner_lib.InferenceOptions(batch_size=8))
+  ids, quals = runner.predict(rows)
+  return np.asarray(ids), np.asarray(quals)
+
+
+@pytest.fixture(scope='module')
+def undisturbed_run(shards, tmp_path_factory):
+  """One full cycle with no faults — the baseline every drill compares
+  against (byte-identical serving, teacher checkpoint reuse)."""
+  out = str(tmp_path_factory.mktemp('fw_baseline') / 'fw')
+  result = _run_cli(_flywheel_args(out, shards))
+  assert result.returncode == 0, result.stderr[-4000:]
+  return out
+
+
+@_DRILL
+@pytest.mark.slow
+def test_sigkill_at_every_stage_boundary_then_resume(
+    shards, undisturbed_run, tmp_path_factory):
+  """ROADMAP item 3 acceptance drill: SIGKILL right after each stage's
+  `running` journal commit (the worst-timed crash), resume each time,
+  and the final artifact must serve byte-identically to the
+  undisturbed baseline with every gate recorded exactly once."""
+  out = str(tmp_path_factory.mktemp('fw_drill') / 'fw')
+  for i, stage in enumerate(flywheel_lib.STAGE_ORDER):
+    extra = () if stage == 'train' else ('--resume',)
+    result = _run_cli(
+        _flywheel_args(out, shards, *extra),
+        env_extra={faults_lib.ENV_FLYWHEEL_KILL_AT_STAGE: stage})
+    assert result.returncode == -signal.SIGKILL, (
+        stage, result.returncode, result.stderr[-2000:])
+    statuses, _ = _journal_statuses(out)
+    assert statuses[stage] == 'running'
+    for earlier in flywheel_lib.STAGE_ORDER[:i]:
+      assert statuses[earlier] == 'done'
+
+  final = _run_cli(_flywheel_args(out, shards, '--resume'))
+  assert final.returncode == 0, final.stderr[-4000:]
+  payload = json.loads(final.stdout)
+  assert [g['name'] for g in payload['gates']] == [
+      'int8_alignment_identity_delta', 'bf16_max_qv_delta']
+  assert all(g['passed'] for g in payload['gates'])
+
+  statuses, journal = _journal_statuses(out)
+  assert statuses == {s: 'done' for s in flywheel_lib.STAGE_ORDER}
+  for stage in flywheel_lib.STAGE_ORDER:
+    assert journal['stages'][stage]['n_resumes'] == 1
+
+  with open(os.path.join(out, flywheel_lib.MANIFEST_NAME)) as f:
+    manifest = json.load(f)
+  assert manifest['ok'] is True
+  assert manifest['schema_version'] == flywheel_lib.MANIFEST_SCHEMA_VERSION
+  assert manifest['counters'] == {'n_stage_resumes': 4,
+                                  'n_stage_retries': 0}
+  assert len(manifest['gates']) == 2  # measured exactly once
+
+  ids_d, quals_d = _served_planes(os.path.join(out, 'export'))
+  ids_b, quals_b = _served_planes(os.path.join(undisturbed_run, 'export'))
+  np.testing.assert_array_equal(ids_d, ids_b)
+  np.testing.assert_array_equal(quals_d, quals_b)
+
+
+@_DRILL
+@pytest.mark.slow
+def test_gate_failure_resume_still_exits_3(
+    shards, undisturbed_run, tmp_path_factory):
+  """A failed gate is durable: rerunning with --resume re-verifies the
+  journaled measurement (no re-eval) and still refuses to export."""
+  ckpts = glob_lib.glob(
+      os.path.join(undisturbed_run, 'teacher', 'checkpoints',
+                   'checkpoint-*'))
+  teacher_ckpt = max(ckpts, key=lambda p: int(p.rsplit('-', 1)[1]))
+  out = str(tmp_path_factory.mktemp('fw_gatefail') / 'fw')
+  args = _flywheel_args(out, shards,
+                        '--teacher_checkpoint', teacher_ckpt,
+                        '--bf16_gate', '-1')
+
+  first = _run_cli(args)
+  assert first.returncode == 3, first.stderr[-4000:]
+  statuses, journal = _journal_statuses(out)
+  assert statuses['gates'] == 'done'  # measured, then enforcement failed
+  assert 'export' not in statuses
+  assert not os.path.isdir(os.path.join(out, 'export'))
+
+  second = _run_cli(args + ['--resume'])
+  assert second.returncode == 3, second.stderr[-4000:]
+  _, journal = _journal_statuses(out)
+  assert journal['stages']['gates']['n_resumes'] == 0  # not re-measured
+  with open(os.path.join(out, flywheel_lib.MANIFEST_NAME)) as f:
+    manifest = json.load(f)
+  assert manifest['ok'] is False
+  failed = [g for g in manifest['gates'] if not g['passed']]
+  assert [g['name'] for g in failed] == ['bf16_max_qv_delta']
+
+
+@_DRILL
+@pytest.mark.slow
+def test_export_reentry_is_idempotent_and_stale_journal_rejected(
+    shards, undisturbed_run, tmp_path_factory):
+  out = str(tmp_path_factory.mktemp('fw_reentry') / 'fw')
+  shutil.copytree(undisturbed_run, out)
+
+  # Simulate a crash mid-export: journal says `running`, staging holds
+  # junk, and the published dir is wreckage from an interrupted publish.
+  journal_path = os.path.join(out, flywheel_lib.JOURNAL_NAME)
+  with open(journal_path) as f:
+    journal = json.load(f)
+  journal['stages']['export']['status'] = 'running'
+  flywheel_lib.atomic_write_json(journal_path, journal)
+  staging = os.path.join(out, flywheel_lib.EXPORT_STAGING)
+  os.makedirs(staging, exist_ok=True)
+  with open(os.path.join(staging, 'junk'), 'w') as f:
+    f.write('half-written')
+  with open(os.path.join(out, 'export', 'wreckage'), 'w') as f:
+    f.write('stale')
+
+  result = _run_cli(_flywheel_args(out, shards, '--resume'))
+  assert result.returncode == 0, result.stderr[-4000:]
+  assert not os.path.exists(staging)  # published atomically
+  export_dir = os.path.join(out, 'export')
+  assert os.path.exists(os.path.join(export_dir, 'serving.stablehlo'))
+  assert not os.path.exists(os.path.join(export_dir, 'wreckage'))
+  _, journal = _journal_statuses(out)
+  assert journal['stages']['export']['status'] == 'done'
+  assert journal['stages']['export']['n_resumes'] == 1
+
+  # Stale journal: same out_dir, changed gate threshold -> typed
+  # rejection (exit 2) naming the drifted field, nothing re-run.
+  stale = _run_cli(_flywheel_args(out, shards, '--resume',
+                                  '--bf16_gate', '99'))
+  assert stale.returncode == 2, (stale.returncode, stale.stderr[-2000:])
+  assert 'bf16_gate_threshold' in stale.stderr
+
+
+@_DRILL
+@pytest.mark.slow
+def test_sigterm_mid_train_interrupts_then_resume_completes(
+    shards, tmp_path_factory):
+  """Preemption notice mid-train: checkpoint, journal `interrupted`,
+  exit 0 with a resume hint; --resume finishes the cycle."""
+  out = str(tmp_path_factory.mktemp('fw_sigterm') / 'fw')
+  args = _flywheel_args(out, shards)
+  first = _run_cli(args,
+                   env_extra={faults_lib.ENV_SIGTERM_AT_STEP: '3'})
+  assert first.returncode == 0, first.stderr[-4000:]
+  payload = json.loads(first.stdout)
+  assert payload['interrupted'] == 'train'
+  assert '--resume' in payload['resume']
+  statuses, _ = _journal_statuses(out)
+  assert statuses['train'] == 'interrupted'
+
+  second = _run_cli(args + ['--resume'])
+  assert second.returncode == 0, second.stderr[-4000:]
+  payload = json.loads(second.stdout)
+  assert all(g['passed'] for g in payload['gates'])
+  statuses, journal = _journal_statuses(out)
+  assert statuses == {s: 'done' for s in flywheel_lib.STAGE_ORDER}
+  assert journal['stages']['train']['n_resumes'] == 1
+
+
+@_DRILL
+@pytest.mark.slow
+def test_mid_train_host_loss_degrades_and_completes(
+    shards, tmp_path_factory):
+  """Two elastic hosts share one cycle; host 1 is lost mid-train. The
+  survivor rebuilds the pod, finishes training solo, and carries the
+  cycle through gates and export."""
+  out = str(tmp_path_factory.mktemp('fw_hostloss') / 'fw')
+  args = _flywheel_args(out, shards, '--elastic', '--num_processes', '2',
+                        '--elastic_barrier_timeout', '5')
+  env = dict(os.environ, **_DRILL_ENV)
+  env[faults_lib.ENV_HOST_LOST_AT_STEP] = '3'
+  env[faults_lib.ENV_HOST_LOST_HOST] = '1'
+  cmd = [sys.executable, '-m', 'deepconsensus_tpu.cli', 'flywheel']
+  procs = []
+  for host in (1, 0):
+    procs.append(subprocess.Popen(
+        cmd + args + ['--process_id', str(host)],
+        env=env, cwd=_REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+  out1, err1 = procs[0].communicate(timeout=570)
+  out0, err0 = procs[1].communicate(timeout=570)
+  assert procs[0].returncode == -signal.SIGKILL, (out1, err1[-2000:])
+  assert procs[1].returncode == 0, err0[-4000:]
+  payload = json.loads(out0)
+  assert all(g['passed'] for g in payload['gates'])
+  statuses, _ = _journal_statuses(out)
+  assert statuses == {s: 'done' for s in flywheel_lib.STAGE_ORDER}

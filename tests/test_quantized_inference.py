@@ -146,8 +146,9 @@ def test_int8_identity_within_gate_of_f32(tmp_path):
       out_dir=str(tmp_path / 'int8'), variables=variables_q)
 
   delta = abs(quant['alignment_identity'] - base['alignment_identity'])
-  assert delta <= 0.002, (
-      f'int8 identity gate failed: |delta|={delta:.5f} > 0.002 '
+  assert delta <= config_lib.INT8_IDENTITY_GATE, (
+      f'int8 identity gate failed: |delta|={delta:.5f} > '
+      f'{config_lib.INT8_IDENTITY_GATE} '
       f'(f32={base["alignment_identity"]:.5f}, '
       f'int8={quant["alignment_identity"]:.5f})')
 
@@ -168,7 +169,7 @@ def test_int8_identity_gate_on_reference_eval_set(tmp_path, testdata_dir):
       params=params_q, checkpoint_path=None, eval_patterns=patterns,
       out_dir=str(tmp_path / 'int8'), variables=variables_q)
   assert abs(quant['alignment_identity']
-             - base['alignment_identity']) <= 0.002
+             - base['alignment_identity']) <= config_lib.INT8_IDENTITY_GATE
 
 
 def test_bf16_fused_model_matches_f32():
@@ -204,8 +205,21 @@ def test_bf16_fused_model_matches_f32():
 # many units (bf16 logit rounding is ~1e-2 relative; on the synthetic
 # BAMs the measured max delta is <=1, the gate leaves margin for other
 # inputs). Reads whose argmax flips at a near-tie are excluded from
-# the per-base comparison but bounded in count below.
-MAX_QV_DELTA = 3
+# the per-base comparison but bounded in count below. The value lives
+# in models/config.py, the one shared home for gate thresholds.
+MAX_QV_DELTA = config_lib.BF16_QV_GATE
+
+
+def test_gate_thresholds_have_one_shared_home():
+  """The runtime flywheel gates and these acceptance tests must use
+  the SAME thresholds: both sides import them from models/config.py,
+  and this test pins the flywheel re-exports to that home so neither
+  can drift silently."""
+  from deepconsensus_tpu.models import flywheel as flywheel_lib
+
+  assert flywheel_lib.INT8_IDENTITY_GATE is config_lib.INT8_IDENTITY_GATE
+  assert flywheel_lib.BF16_QV_GATE is config_lib.BF16_QV_GATE
+  assert MAX_QV_DELTA == config_lib.BF16_QV_GATE
 
 
 def test_fastq_f32_vs_bf16_qv_delta(tmp_path, synthetic_bams):

@@ -54,6 +54,8 @@ FAULT_TYPES = frozenset({
     'NonFiniteTrainingError',
     'BucketedTrainingError',
     'FlywheelGateError',
+    'FlywheelStageError',
+    'FlywheelResumeError',
     'ExportedArtifactMismatchError',
     'DeviceFault',
     'DeviceOomError',
