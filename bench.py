@@ -698,6 +698,11 @@ def main():
     _dp_scaling_stage(details, budget_left)
     if budget_left() > 120:
       _train_dp_scaling_stage(details, budget_left)
+    # The compile-once-per-bucket gate and the bucketed-vs-pad-to-max
+    # TRAINING padding delta are stream arithmetic (CPU-provable);
+    # windows/s defers to hardware.
+    if budget_left() > 150:
+      _train_bucketed_stage(details, budget_left)
     # The bytes/pack ratio is backend-independent (CPU proof of the
     # 4x D2H reduction); the windows/s A/B defers to real hardware.
     if budget_left() > 90:
@@ -743,6 +748,8 @@ def main():
   _dp_scaling_stage(details, budget_left)
   if budget_left() > 120:
     _train_dp_scaling_stage(details, budget_left)
+  if budget_left() > 150:
+    _train_bucketed_stage(details, budget_left)
 
   # Stage 4: batch sweep.
   for b in (2048, 4096):
@@ -1214,6 +1221,66 @@ def _train_dp_scaling_stage(details, budget_left):
       json.dump(payload, f, indent=1)
   except OSError:
     pass
+
+
+def _train_bucketed_stage(details, budget_left):
+  """Bucketed multi-width TRAINING over the default (100, 200) bucket
+  set (round-20): a short real run_training on a mixed-width synthetic
+  stream at dp in {1, 8}, via scripts/bench_train_scaling.py
+  --window_buckets. Reported per dp: n_train_forward_shapes (the
+  compile-once-per-bucket gate — equals the bucket count, i.e. zero
+  mid-run retraces), per-bucket batch counters, the measured
+  train_padding_fraction under bucketing, padding_fraction_padmax (the
+  waste the SAME stream pays under the old pad-to-widest single-shape
+  policy), and the cross-dp loss-curve digest. The padding delta is
+  stream arithmetic (backend-independent); the windows/s A/B against
+  pad-to-max defers to live chips (scripts/measure_r4.sh
+  train_bucketed / train_L500)."""
+  repo = os.path.dirname(os.path.abspath(__file__))
+  script = os.path.join(repo, 'scripts', 'bench_train_scaling.py')
+  env = dict(os.environ)
+  env['PYTHONPATH'] = f"{repo}:{env.get('PYTHONPATH', '')}".rstrip(':')
+  env.pop('DC_BENCH_CPU', None)
+  rows = []
+  for dp in (1, 8):
+    if budget_left() < 120:
+      rows.append({'dp': dp, 'error': 'skipped: bench budget exhausted'})
+      continue
+    cmd = [sys.executable, script, '--dp', str(dp),
+           '--force_host_devices', '8', '--global_batch', '8',
+           '--train_steps', '4', '--window_buckets', '100,200']
+    try:
+      proc = subprocess.run(
+          cmd, capture_output=True, text=True, env=env,
+          timeout=min(420, max(120, budget_left() - 30)))
+      line = next((l for l in reversed(proc.stdout.splitlines())
+                   if l.startswith('{')), None)
+      if line:
+        rows.append(json.loads(line))
+      else:
+        rows.append({'dp': dp,
+                     'error': f'no JSON line (rc={proc.returncode}): '
+                              + proc.stderr.strip()[-160:]})
+    except Exception as e:
+      rows.append({'dp': dp, 'error': repr(e)[:200]})
+    details['stages']['train_bucketed'] = {'rows': rows}
+    _write_details(details)
+  digests = {r.get('loss_curve_digest_1e4') for r in rows
+             if 'loss_curve_digest_1e4' in r}
+  details['stages']['train_bucketed'] = {
+      'rows': rows,
+      'window_buckets': [100, 200],
+      'loss_curve_identical_across_dp': len(digests) == 1 and bool(digests),
+      'compile_once_per_bucket': all(
+          r.get('n_train_forward_shapes') == 2.0 for r in rows
+          if 'error' not in r) and any('error' not in r for r in rows),
+      'note': ('Digest equality across dp can be broken by a loss '
+               'straddling a 1e-4 quantization boundary (all-reduce '
+               'summation order, ~1e-7 relative); '
+               'tests/test_longwin_training.py asserts the tighter '
+               'rtol=1e-4 elementwise contract.'),
+  }
+  _write_details(details)
 
 
 def _is_metric_line(line: str):

@@ -41,13 +41,22 @@ def _coerce_override(raw: str, current):
 def _apply_overrides(params, overrides: List[str]) -> None:
   """Applies --set KEY=VALUE items to an unlocked-able config. Must run
   before finalize_params so derived values (total_rows, hidden_size)
-  see the overrides."""
+  see the overrides. Transformer size keys (num_hidden_layers,
+  num_heads, filter_size) only materialize inside finalize_params,
+  which fills them from the size preset ONLY when absent — so
+  pre-setting them here is legal and wins over the preset."""
+  from deepconsensus_tpu.models import config as config_lib
+
+  late_keys = frozenset(
+      k for preset in config_lib.TRANSFORMER_SIZE_PARAMS.values()
+      for k in preset)
   with params.unlocked():
     for item in overrides:
       key, eq, raw = item.partition('=')
-      if not eq or not hasattr(params, key):
+      if not eq or not (hasattr(params, key) or key in late_keys):
         raise ValueError(f'unknown config override {item!r}')
-      setattr(params, key, _coerce_override(raw, getattr(params, key)))
+      setattr(params, key,
+              _coerce_override(raw, getattr(params, key, None)))
 
 
 def _add_preprocess(sub):
@@ -224,6 +233,23 @@ def _add_bucket_flag(p):
                  'form a divisibility chain (the default 100,200 '
                  'does). Off: the per-bucket packers (byte-identical '
                  'output either way).')
+
+
+def _add_train_bucket_flag(p):
+  # Training-side counterpart of _add_bucket_flag: buckets only (the
+  # ragged pack stream is an inference dispatch mode).
+  p.add_argument('--window_buckets', default=None,
+                 type=_parse_window_buckets, metavar='L1,L2,...',
+                 help='Bucketed multi-width training, e.g. 100,200: '
+                 'each window pads to the smallest bucket that fits, '
+                 'batches stay width-pure, and each bucket compiles '
+                 'exactly ONE train step over the shared param tree '
+                 '(n_train_forward_shapes == number of buckets, zero '
+                 'mid-run recompiles). Widths at or past 256 route '
+                 'attention through the blockwise ring scan (the L=500 '
+                 'long-insert path; requires attention_dropout=0). The '
+                 'smallest bucket must equal max_length. Default: '
+                 'single-shape pad-to-max.')
 
 
 def _add_device_fault_flags(p):
@@ -557,6 +583,7 @@ def _add_train(sub):
                  action='store_false',
                  help='Refuse re-admission; a lost host stays lost '
                  'until the run restarts.')
+  _add_train_bucket_flag(p)
 
 
 def _add_evaluate(sub):
@@ -636,6 +663,7 @@ def _add_distill(sub):
                  dest='overrides',
                  help='Student config override, repeatable (same semantics '
                  'as train --set; applied before finalize_params).')
+  _add_train_bucket_flag(p)
 
 
 def _add_flywheel(sub):
@@ -698,6 +726,14 @@ def _add_flywheel(sub):
                  action='store_true', default=True)
   p.add_argument('--no_elastic_readmit', dest='elastic_readmit',
                  action='store_false')
+  _add_train_bucket_flag(p)
+  p.add_argument('--baseline_checkpoint', default=None,
+                 help='Reference checkpoint (e.g. the L=100 production '
+                 'model) to evaluate on the same eval shards as the '
+                 'student: the gates stage records an informational '
+                 'long_insert_identity_vs_baseline entry comparing '
+                 'alignment_identity student vs baseline in the '
+                 'manifest (never vetoes export).')
   _add_quant_flags(p)
 
 
@@ -1255,6 +1291,8 @@ def _dispatch(args) -> int:
         params.batch_size = args.batch_size
       if args.on_shard_error:
         params.on_shard_error = args.on_shard_error
+      if args.window_buckets:
+        params.window_buckets = args.window_buckets
       params.on_device_error = args.on_device_error
       params.on_host_error = args.on_host_error
       params.elastic_barrier_timeout = args.elastic_barrier_timeout
@@ -1371,9 +1409,11 @@ def _dispatch(args) -> int:
     student_params = config_lib.get_config(args.config)
     _apply_overrides(student_params, args.overrides)
     config_lib.finalize_params(student_params)
-    if args.batch_size:
-      with student_params.unlocked():
+    with student_params.unlocked():
+      if args.batch_size:
         student_params.batch_size = args.batch_size
+      if args.window_buckets:
+        student_params.window_buckets = args.window_buckets
     distill_lib.run_distillation(
         params=student_params,
         teacher_params_cfg=teacher_params,
@@ -1425,6 +1465,8 @@ def _dispatch(args) -> int:
           mesh=mesh,
           resume=args.resume,
           elastic_config=elastic_config,
+          window_buckets=args.window_buckets,
+          baseline_checkpoint=args.baseline_checkpoint,
           **kwargs,
       )
     except faults_lib.FlywheelGateError as e:

@@ -202,13 +202,15 @@ class NonFiniteTrainingError(RuntimeError):
   instead of looping on a diverged model."""
 
 
-class BucketedTrainingError(ValueError):
-  """`dctpu train` was handed a multi-bucket window config. Training
-  fixes ONE window shape (the jitted step compiles for a single
-  [B, R, L, 1] geometry); variable-length buckets are an inference
-  lever (PR 12's ragged dispatch). Raised at config time with the
-  actionable remedy instead of failing later with an opaque shape
-  mismatch inside the jitted step. Operator error: exit code 2."""
+class WindowBucketError(ValueError):
+  """`window_buckets` itself is invalid: non-increasing widths, a
+  width below the condenser chunk, a largest bucket that disagrees
+  with `max_length`, or a model family whose parameter shapes depend
+  on the window width (the FC head sizes its output Dense by
+  max_length, so one param tree cannot serve two widths). Raised at
+  config time with the actionable remedy instead of failing later
+  with an opaque shape mismatch inside a jitted step. Operator
+  error: exit code 2."""
 
 
 class FlywheelGateError(RuntimeError):

@@ -47,6 +47,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ENV_DEVICE_LOST_AT_PACK,
     ENV_DEVICE_LOST_AT_STEP,
     ENV_DEVICE_OOM_AT_PACK,
+    ENV_FLYWHEEL_KILL_AT_STAGE,
     ENV_HOST_LOST_AT_STEP,
     ENV_HOST_LOST_HOST,
     ENV_HOST_LOST_MODE,
@@ -64,7 +65,6 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     _TRANSIENT_MARKERS,
     BackpressureError,
     BadRequestError,
-    BucketedTrainingError,
     CorruptInputError,
     CrashLoopError,
     DeadLetterWriter,
@@ -79,6 +79,8 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     FaultKind,
     FleetRejection,
     FlywheelGateError,
+    FlywheelResumeError,
+    FlywheelStageError,
     HostLostError,
     InjectedHostDeath,
     NonFiniteTrainingError,
@@ -86,6 +88,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     ReplicaLostError,
     RequestTooLargeError,
     ServeRejection,
+    WindowBucketError,
     classify_device_error,
     classify_error,
     host_rejoin_step,
@@ -94,6 +97,7 @@ from deepconsensus_tpu.faults import (  # noqa: F401 - re-exports
     injected_device_hang,
     injected_train_device_fault,
     maybe_host_lost,
+    maybe_kill_flywheel_at_stage,
     maybe_kill_shard_reader,
     maybe_kill_train_at_step,
     maybe_kill_worker,

@@ -31,6 +31,17 @@ FUSED_MAX_WINDOW_LEN = 128
 # by a config: the reference L=100 plus one 2x bucket (the distill
 # configs' target geometry, arxiv 2211.09862).
 DEFAULT_WINDOW_BUCKETS = (100, 200)
+# Long-insert geometry. Training windows at or past
+# RING_ATTENTION_MIN_LEN route BandedSelfAttention through the
+# blockwise ring-attention scan (parallel/ring_attention.py) instead
+# of materializing the [B, N, L, L] logits: at L=500 the full logits
+# tensor no longer fits the fused kernel's VMEM tiling, and the
+# banded structure makes the blockwise online-softmax pass both exact
+# and memory-bounded. Buckets below the crossover (100, 200) keep the
+# XLA einsum path, whose fused/Pallas eligibility is decided
+# downstream by _fused_hotpath_eligible.
+RING_ATTENTION_MIN_LEN = 256
+LONG_INSERT_WINDOW_LEN = 500
 
 # Quantization acceptance gates — the ONE shared home. The runtime
 # gates (models/flywheel.py) and the acceptance tests

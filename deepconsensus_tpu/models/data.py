@@ -16,7 +16,7 @@ import ml_collections
 import numpy as np
 
 from deepconsensus_tpu import constants
-from deepconsensus_tpu.faults import CorruptInputError
+from deepconsensus_tpu.faults import CorruptInputError, WindowBucketError
 from deepconsensus_tpu.io.example_proto import Example
 from deepconsensus_tpu.models import config
 from deepconsensus_tpu.io.tfrecord import read_tfrecords
@@ -48,12 +48,15 @@ def format_rows_batch(
     subreads: np.ndarray,
     params: ml_collections.ConfigDict,
     window_buckets: Sequence[int] = (),
+    names: Sequence = (),
 ) -> np.ndarray:
   """format_rows over a whole window batch [N, H, L, 1] at once —
   one set of slice/clip/concat ops instead of N (the per-window calls
   were a measured host-side cost in the inference model stage).
   window_buckets overrides the allowed widths (callers whose buckets
-  come from InferenceOptions rather than params)."""
+  come from InferenceOptions rather than params). `names` (window ids,
+  when the caller tracks them) only feeds the rejection message so an
+  off-bucket window is attributable to its ZMW."""
   example_layout = layout_from_shape(subreads.shape[1:], params.use_ccs_bq)
   (base_r, pw_r, ip_r, strand_r, ccs_r, ccs_bq_r, sn_r) = row_indices(
       example_layout.max_passes, params.use_ccs_bq
@@ -79,11 +82,14 @@ def format_rows_batch(
              else config.resolve_window_buckets(params))
   width = rows.shape[2]
   if width not in buckets:
-    # dclint: allow=typed-faults (caller shape contract, not a
-    # data-plane fault: the window width must be one of the model's
-    # configured length buckets)
-    raise ValueError(
-        f'window width {width} not in window buckets {buckets}')
+    who = ''
+    if len(names):
+      shown = [str(n) for n in list(names)[:3]]
+      who = f' (window id(s) {shown}{"..." if len(names) > 3 else ""})'
+    raise WindowBucketError(
+        f'window width {width} not in window buckets {buckets}{who}; '
+        f'triage the window into a bucket (pad) or run with '
+        f'--on_shard_error=skip to quarantine it (n_width_rejected)')
   expected = (len(subreads), params.total_rows, width, 1)
   assert rows.shape == expected, rows.shape
   return rows
@@ -224,19 +230,51 @@ def _shard_reader_main(paths, inference: bool, seed: int, out_queue,
       )
 
 
+def _window_width(parsed: Dict[str, np.ndarray]) -> int:
+  """Window width of one minimal parse ([H, L, 1] subreads)."""
+  return int(parsed['subreads'].shape[1])
+
+
+def _pad_minimal(
+    parsed: Dict[str, np.ndarray], pad_to: int
+) -> Dict[str, np.ndarray]:
+  """Pads one minimal parse's window axis up to its bucket width.
+
+  Zero is the canonical absent value for every row family (gap base,
+  no kinetics, UNKNOWN strand) and for the label (gap, shifted away by
+  left_shift / ignored by the alignment loss), so padding a width-w
+  window to its bucket is semantically a no-op — the same pad the
+  featurize stage applies when a smart window comes up short."""
+  w = _window_width(parsed)
+  if w == pad_to:
+    return parsed
+  out = dict(parsed)
+  out['subreads'] = np.pad(
+      parsed['subreads'], ((0, 0), (0, pad_to - w), (0, 0)))
+  if 'label' in parsed:
+    out['label'] = np.pad(parsed['label'], (0, pad_to - w))
+  return out
+
+
 def _batch_from_minimal(
     chosen: List[Dict[str, np.ndarray]],
     params: ml_collections.ConfigDict,
     inference: bool,
+    pad_to: int = 0,
 ) -> Dict[str, np.ndarray]:
-  """Stacks minimal parses into a formatted (rows, label) batch."""
+  """Stacks minimal parses into a formatted (rows, label) batch.
+  pad_to > 0 pads every window up to that bucket width first (the
+  bucketed-training triage path)."""
+  if pad_to:
+    chosen = [_pad_minimal(c, pad_to) for c in chosen]
+  names = ([c['name'] for c in chosen] if 'name' in chosen[0] else [])
   batch = {
       'rows': format_rows_batch(
-          np.stack([c['subreads'] for c in chosen]), params
+          np.stack([c['subreads'] for c in chosen]), params, names=names
       )
   }
-  if 'name' in chosen[0]:
-    batch['name'] = np.asarray([c['name'] for c in chosen], dtype=object)
+  if names:
+    batch['name'] = np.asarray(names, dtype=object)
   if not inference:
     label = np.stack([c['label'] for c in chosen])
     if params.remove_label_gaps:
@@ -285,46 +323,119 @@ class DatasetIterator:
 
   def __post_init__(self):
     with_name = bool(self.params.get('track_window_ids', False))
-    minimal: List[Dict[str, np.ndarray]] = []
+    buckets = config.resolve_window_buckets(self.params)
+    grouped: Dict[int, List[Dict[str, np.ndarray]]] = {}
     for i, raw in enumerate(read_tfrecords(self.patterns)):
       if 0 <= self.limit <= i:
         break
-      minimal.append(parse_example_minimal(raw, self.inference, with_name))
-    if not minimal:
+      parsed = parse_example_minimal(raw, self.inference, with_name)
+      width = _window_width(parsed)
+      bucket = config.bucket_for(width, buckets)
+      if bucket is None:
+        who = parsed.get('name')
+        raise WindowBucketError(
+            f'window width {width} overflows window buckets {buckets}'
+            + (f' (window id {who!r})' if who is not None else ''))
+      grouped.setdefault(bucket, []).append(parsed)
+    if not grouped:
       # dclint: allow=typed-faults (startup config error: the operator
       # pointed the loader at an empty glob)
       raise ValueError(f'no examples matched {self.patterns!r}')
-    batch = _batch_from_minimal(minimal, self.params, self.inference)
-    minimal.clear()
-    self.rows = batch['rows']
-    self.labels = batch.get('label')
-    self.names = batch.get('name')
+    # One formatted array group per occupied bucket, every window
+    # padded to its bucket width; single-occupied-bucket corpora keep
+    # the legacy flat rows/labels/names layout (and its exact sampling
+    # order) so fixed-shape training is bit-identical to before.
+    # Per-example pre-pad widths ride along for the padding-waste
+    # counters.
+    self._groups = {}
+    for b in sorted(grouped):
+      group = _batch_from_minimal(grouped[b], self.params,
+                                  self.inference, pad_to=b)
+      group['width'] = np.asarray(
+          [_window_width(p) for p in grouped[b]], dtype=np.int64)
+      self._groups[b] = group
+    grouped.clear()
+    self.counters: collections.Counter = collections.Counter()
+    if len(self._groups) == 1:
+      batch = next(iter(self._groups.values()))
+      self.rows = batch['rows']
+      self.labels = batch.get('label')
+      self.names = batch.get('name')
+    else:
+      self.rows = self.labels = self.names = None
     self._rng = np.random.default_rng(self.seed)
 
   def __len__(self) -> int:
-    return len(self.rows)
+    return sum(len(g['rows']) for g in self._groups.values())
+
+  @property
+  def window_buckets_present(self) -> tuple:
+    return tuple(sorted(self._groups))
 
   @property
   def steps_per_epoch(self) -> int:
     if self.drop_remainder:
-      return len(self.rows) // self.batch_size
-    return -(-len(self.rows) // self.batch_size)
+      return sum(
+          len(g['rows']) // self.batch_size
+          for g in self._groups.values())
+    return sum(
+        -(-len(g['rows']) // self.batch_size)
+        for g in self._groups.values())
+
+  def _count_emit(self, bucket: int, widths: np.ndarray) -> None:
+    self.counters[f'n_train_batches_by_bucket_{bucket}'] += 1
+    self.counters['n_train_padded_positions'] += int(
+        (bucket - widths).sum())
+    self.counters['n_train_window_positions'] += int(
+        bucket * len(widths))
 
   def epoch(self) -> Iterator[Dict[str, np.ndarray]]:
-    order = np.arange(len(self.rows))
+    if self.rows is not None:
+      # Legacy single-shape path, untouched ordering.
+      bucket, g = next(iter(self._groups.items()))
+      order = np.arange(len(self.rows))
+      if self.shuffle:
+        self._rng.shuffle(order)
+      n = len(order)
+      stop = (
+          n - n % self.batch_size if self.drop_remainder else n
+      )
+      for start in range(0, stop, self.batch_size):
+        idx = order[start : start + self.batch_size]
+        batch = {'rows': self.rows[idx]}
+        if self.names is not None:
+          batch['name'] = self.names[idx]
+        if self.labels is not None:
+          batch['label'] = self.labels[idx]
+        self._count_emit(bucket, g['width'][idx])
+        yield batch
+      return
+    # Bucketed epoch: shuffle within each bucket, then interleave the
+    # per-bucket batch slots deterministically (seeded rng when
+    # shuffling, narrow-to-wide otherwise) so resume/fast-forward
+    # replays the identical batch sequence.
+    slots: List[tuple] = []
+    orders: Dict[int, np.ndarray] = {}
+    for b in sorted(self._groups):
+      g = self._groups[b]
+      order = np.arange(len(g['rows']))
+      if self.shuffle:
+        self._rng.shuffle(order)
+      orders[b] = order
+      n = len(order)
+      stop = n - n % self.batch_size if self.drop_remainder else n
+      slots.extend((b, start) for start in range(0, stop, self.batch_size))
     if self.shuffle:
-      self._rng.shuffle(order)
-    n = len(order)
-    stop = (
-        n - n % self.batch_size if self.drop_remainder else n
-    )
-    for start in range(0, stop, self.batch_size):
-      idx = order[start : start + self.batch_size]
-      batch = {'rows': self.rows[idx]}
-      if self.names is not None:
-        batch['name'] = self.names[idx]
-      if self.labels is not None:
-        batch['label'] = self.labels[idx]
+      self._rng.shuffle(slots)
+    for b, start in slots:
+      g = self._groups[b]
+      idx = orders[b][start : start + self.batch_size]
+      batch = {'rows': g['rows'][idx]}
+      if g.get('name') is not None:
+        batch['name'] = g['name'][idx]
+      if g.get('label') is not None:
+        batch['label'] = g['label'][idx]
+      self._count_emit(b, g['width'][idx])
       yield batch
 
   def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
@@ -385,11 +496,19 @@ class StreamingDataset:
       # dclint: allow=typed-faults (startup config error: the operator
       # pointed the loader at an empty glob)
       raise ValueError(f'no shards matched {self.patterns!r}')
+    # dclint: lock-free (reassign_hosts replaces the whole list in one
+    # reference assignment; the reader thread sees the old or the new
+    # list, never a mix)
     self._paths = self._assigned_paths(self.host_rank, self.host_count)
     self._rng = np.random.default_rng(self.seed)
     self._with_name = bool(self.params.get('track_window_ids', False))
+    self._buckets = config.resolve_window_buckets(self.params)
     # Fault counters (n_shard_errors, ...) survive the iterator so the
     # training driver can report them at end of run.
+    # dclint: lock-free (the reader thread and the consuming train loop
+    # increment DISJOINT key sets — producer: shard/record decode
+    # faults; consumer: per-bucket emission counters — and each
+    # Counter bump is a single GIL-atomic dict op per key)
     self.counters: collections.Counter = collections.Counter()
 
   def _assigned_paths(self, rank: int, count: int) -> list:
@@ -413,6 +532,10 @@ class StreamingDataset:
     shard currently being read finishes under the old assignment. The
     swap is a single reference assignment, so the reader thread sees
     either the old or the new list, never a mix."""
+    # dclint: lock-free (host_rank/host_count are written only here,
+    # on the consuming thread; the reader thread takes the companion
+    # self._paths swap below — these two scalars only feed logging and
+    # this no-op check)
     if (rank, count) == (self.host_rank, self.host_count):
       return
     self.host_rank, self.host_count = int(rank), int(count)
@@ -624,16 +747,29 @@ class StreamingDataset:
       return payload
 
     try:
-      buffer: List[Dict[str, np.ndarray]] = []
-      fill_target = max(self.buffer_size, self.batch_size * 2)
-      while True:
-        while len(buffer) < fill_target:
-          buffer.append(next_parsed())
-        idx = self._rng.choice(len(buffer), self.batch_size, replace=False)
-        idx_set = set(idx.tolist())
-        chosen = [buffer[i] for i in idx]
-        buffer = [b for i, b in enumerate(buffer) if i not in idx_set]
-        yield _batch_from_minimal(chosen, self.params, self.inference)
+      if len(self._buckets) == 1:
+        # Legacy fixed-shape reservoir. The rng draw sequence is
+        # bit-identical to the pre-bucketing loader for on-bucket
+        # corpora (triage only intervenes on narrow windows, which pad,
+        # and overflow widths, which quarantine under skip).
+        bucket = self._buckets[0]
+        buffer: List[Dict[str, np.ndarray]] = []
+        fill_target = max(self.buffer_size, self.batch_size * 2)
+        while True:
+          while len(buffer) < fill_target:
+            triaged = self._triage(next_parsed())
+            if triaged is not None:
+              buffer.append(triaged[1])
+          idx = self._rng.choice(len(buffer), self.batch_size,
+                                 replace=False)
+          idx_set = set(idx.tolist())
+          chosen = [buffer[i] for i in idx]
+          buffer = [b for i, b in enumerate(buffer) if i not in idx_set]
+          self._count_emit(bucket, chosen)
+          yield _batch_from_minimal(chosen, self.params, self.inference,
+                                    pad_to=bucket)
+      else:
+        yield from self._bucketed_batches(next_parsed)
     finally:
       # Stop the producer when the consumer abandons the iterator
       # (GeneratorExit) so retries don't accumulate blocked threads.
@@ -644,6 +780,135 @@ class StreamingDataset:
       # die with the interpreter in that case.
       stop.set()
       thread.join(timeout=15)
+
+  def _triage(self, parsed: Dict[str, np.ndarray]):
+    """(bucket, parse) for the smallest bucket that fits the window, or
+    None after quarantining an overflow width (on_shard_error=skip +
+    n_width_rejected; under 'fail' the typed fault names the window)."""
+    width = _window_width(parsed)
+    bucket = config.bucket_for(width, self._buckets)
+    if bucket is not None:
+      return bucket, parsed
+    who = parsed.get('name')
+    if self.on_shard_error != OnShardError.SKIP:
+      raise WindowBucketError(
+          f'window width {width} overflows window buckets '
+          f'{self._buckets}'
+          + (f' (window id {who!r})' if who is not None else '')
+          + '; widen window_buckets or run with --on_shard_error=skip '
+          'to quarantine it')
+    self.counters['n_width_rejected'] += 1
+    log.warning(
+        'on_shard_error=skip: window width %d overflows buckets %s%s; '
+        'rejected (n_width_rejected)', width, self._buckets,
+        f' (window id {who!r})' if who is not None else '')
+    return None
+
+  def _count_emit(self, bucket: int, chosen: List[Dict]) -> None:
+    """Per-bucket emission counters. The padded/total position pair is
+    what the trainer turns into train_padding_fraction."""
+    self.counters[f'n_train_batches_by_bucket_{bucket}'] += 1
+    pad = sum(bucket - _window_width(c) for c in chosen)
+    self.counters['n_train_padded_positions'] += pad
+    self.counters['n_train_window_positions'] += bucket * len(chosen)
+
+  def _bucketed_batches(self, next_parsed) -> Iterator[Dict[str, np.ndarray]]:
+    """Multi-bucket consumer: per-bucket accumulation under a shared
+    batch clock, mirroring the PR-12 inference engine's per-bucket
+    packers.
+
+    Every parse is triaged into the smallest fitting bucket's buffer.
+    A bucket emits when it holds a full batch (largest buffer first —
+    the backlog drain rule); a bucket whose oldest pending window has
+    waited `bucket_starvation_batches` clock ticks without filling is
+    flushed by PROMOTING windows from narrower buffers (any window fits
+    a wider bucket at the cost of more padding), so rare wide windows
+    never go stale and every emitted batch still carries batch_size
+    real windows — a fixed per-bucket geometry, never a partial batch
+    that would retrace the jitted step. The whole schedule is a
+    deterministic function of the parse stream and the seeded rng, so
+    skip-based resume/fast-forward replays the identical batch
+    sequence."""
+    batch = self.batch_size
+    buckets = self._buckets
+    starvation = int(
+        self.params.get('bucket_starvation_batches', 8) or 8)
+    fill_target = max(self.buffer_size, batch * 2 * len(buckets))
+    buffers: Dict[int, List[Dict[str, np.ndarray]]] = {
+        b: [] for b in buckets}
+    # Clock tick at which each bucket's current backlog started
+    # waiting; -1 = empty.
+    waiting = {b: -1 for b in buckets}
+    clock = 0
+
+    def ready():
+      return [b for b in buckets if len(buffers[b]) >= batch]
+
+    def starved():
+      out = []
+      for b in buckets:
+        if waiting[b] < 0 or clock - waiting[b] < starvation:
+          continue
+        # Flushable only if promotion from narrower buckets can top the
+        # batch up to full size.
+        if sum(len(buffers[x]) for x in buckets if x <= b) >= batch:
+          out.append(b)
+      return out
+
+    def draw(bucket, take):
+      pool = buffers[bucket]
+      idx = self._rng.choice(len(pool), take, replace=False)
+      idx_set = set(idx.tolist())
+      chosen = [pool[i] for i in idx]
+      buffers[bucket] = [p for i, p in enumerate(pool)
+                         if i not in idx_set]
+      return chosen
+
+    while True:
+      while True:
+        total = sum(len(v) for v in buffers.values())
+        if (ready() or starved()) and total >= fill_target:
+          break
+        triaged = self._triage(next_parsed())
+        if triaged is None:
+          continue
+        b, parsed = triaged
+        buffers[b].append(parsed)
+        if waiting[b] < 0:
+          waiting[b] = clock
+      star = starved()
+      if star:
+        # Widest starving bucket first: its windows cannot be promoted
+        # anywhere else, so it is the one at risk of going stale. (A
+        # starved bucket that meanwhile filled up just emits a normal
+        # full draw — the promotion loop below is a no-op.)
+        bucket = max(star)
+        chosen = draw(bucket, min(len(buffers[bucket]), batch))
+        if len(chosen) < batch:
+          self.counters['n_train_starvation_flushes'] += 1
+          for nb in sorted((x for x in buckets if x < bucket),
+                           reverse=True):
+            need = batch - len(chosen)
+            if not need:
+              break
+            take = min(need, len(buffers[nb]))
+            if take:
+              chosen.extend(draw(nb, take))
+              self.counters['n_train_promoted_windows'] += take
+      else:
+        # Largest backlog first (ties to the wider bucket) keeps every
+        # buffer bounded instead of letting the dominant width starve
+        # the rest of reservoir space.
+        bucket = max(ready(), key=lambda b: (len(buffers[b]), b))
+        chosen = draw(bucket, batch)
+      clock += 1
+      for b in buckets:
+        if not buffers[b]:
+          waiting[b] = -1
+      waiting[bucket] = clock if buffers[bucket] else -1
+      self._count_emit(bucket, chosen)
+      yield _batch_from_minimal(chosen, self.params, self.inference,
+                                pad_to=bucket)
 
 
 def prefetch_iterator(iterator, depth: int = 2):

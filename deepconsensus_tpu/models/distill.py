@@ -208,14 +208,23 @@ def run_distillation(
         'accuracy_total': total,
     }
 
+  # Trace count == distinct compiled batch geometries: a bucketed
+  # corpus (DatasetIterator emits per-bucket batches) compiles one
+  # teacher+student step per bucket width over the shared param trees,
+  # exactly like run_training's n_train_forward_shapes.
+  n_forward_shapes = [0]
+
   def step(state, batch):
+    n_forward_shapes[0] += 1
     grads, m = grads_and_metrics(state, batch)
     return state.apply_gradients(grads=grads), m
 
   # Same declarative rule table as run_training: the student state
   # (params + LAMB moments) shards by partition_rules.DEFAULT_RULES and
   # the batch over the data axis, so distillation scales on the same
-  # meshes as training without its own sharding map.
+  # meshes as training without its own sharding map. compile_parallel
+  # is jax.jit underneath: one executable is cached per bucket width,
+  # with no mid-run recompiles for a fixed bucket set.
   state_sh = trainer.state_shardings(state)
   batch_sh = trainer._batch_sharding()
   train_step = partition_rules.compile_parallel(
@@ -279,6 +288,15 @@ def run_distillation(
     # Final eval + checkpoint, through the same aggregation as
     # run_training so the metric key set (identity_pred, class
     # accuracies, yield) and best_checkpoint_metric behave identically.
+    # The bucket telemetry (batches per width, padding fraction,
+    # compile-once proof) rides the same 'faults' sidecar channel.
+    fault_counters = {k: float(v) for k, v in train_ds.counters.items()}
+    fault_counters['n_train_forward_shapes'] = float(n_forward_shapes[0])
+    total_pos = fault_counters.get('n_train_window_positions', 0.0)
+    if total_pos:
+      fault_counters['train_padding_fraction'] = (
+          fault_counters.get('n_train_padded_positions', 0.0) / total_pos)
+    trainer.log_metrics(step_count, 'faults', fault_counters)
     final = trainer.run_eval(state, eval_ds)
     trainer.save_checkpoint(state, step_count, final)
     return final

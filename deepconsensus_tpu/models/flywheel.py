@@ -409,6 +409,45 @@ def _eval_identity(params, variables, eval_patterns, out_dir) -> float:
   return float(metrics['alignment_identity'])
 
 
+def long_insert_identity_record(student_params, student_variables,
+                                baseline_checkpoint, eval_patterns,
+                                out_dir) -> Dict:
+  """Informational manifest record (passed is always True — it never
+  vetoes export): alignment_identity of the student vs a reference
+  checkpoint (e.g. the L=100 production model) on the same eval
+  shards. This is the acceptance readout for the L=500 long-insert
+  flywheel — the manifest shows the long-window student's identity
+  side by side with the short-window baseline's. A baseline that
+  cannot consume the eval shards (its window_buckets don't cover the
+  long windows) records the typed error instead of aborting the
+  cycle."""
+  student = _eval_identity(
+      student_params, student_variables, eval_patterns,
+      os.path.join(out_dir, 'gate_student_identity'))
+  detail: Dict = {'student_identity': round(student, 6),
+                  'baseline_checkpoint': baseline_checkpoint}
+  measured = None
+  try:
+    base_params = config_lib.read_params_from_json(baseline_checkpoint)
+    config_lib.finalize_params(base_params, is_training=False)
+    base_vars = {
+        'params': checkpoints_lib.load_params(baseline_checkpoint)}
+    baseline = _eval_identity(
+        base_params, base_vars, eval_patterns,
+        os.path.join(out_dir, 'gate_baseline_identity'))
+    detail['baseline_identity'] = round(baseline, 6)
+    measured = round(student - baseline, 6)
+  except Exception as e:  # informational: record, never abort
+    detail['baseline_error'] = f'{type(e).__name__}: {e}'
+  return {
+      'name': 'long_insert_identity_vs_baseline',
+      'threshold': None,
+      'measured': measured,
+      'passed': True,
+      'detail': detail,
+  }
+
+
 def int8_identity_gate(params, variables, eval_patterns, out_dir,
                        threshold: float = INT8_IDENTITY_GATE) -> Dict:
   """|alignment_identity(int8) - alignment_identity(f32)| <= threshold."""
@@ -531,6 +570,8 @@ def run_flywheel(
     mesh=None,
     resume: bool = False,
     elastic_config: Optional[Dict] = None,
+    window_buckets: Optional[Sequence[int]] = None,
+    baseline_checkpoint: Optional[str] = None,
 ) -> Dict:
   """Train -> distill -> gates -> export; returns the manifest dict.
 
@@ -582,6 +623,8 @@ def run_flywheel(
     with p.unlocked():
       if batch_size:
         p.batch_size = batch_size
+      if window_buckets:
+        p.window_buckets = tuple(window_buckets)
     return p
 
   def _student_params():
@@ -591,6 +634,8 @@ def run_flywheel(
     with p.unlocked():
       if batch_size:
         p.batch_size = batch_size
+      if window_buckets:
+        p.window_buckets = tuple(window_buckets)
     return p
 
   def _degrade_pod(err: Exception) -> None:
@@ -621,6 +666,7 @@ def run_flywheel(
         'num_epochs': int(num_epochs or 0),
         'train_patterns': list(train_patterns),
         'eval_patterns': list(eval_patterns),
+        'window_buckets': list(window_buckets or ()),
     }
 
     def run() -> Dict:
@@ -676,6 +722,7 @@ def run_flywheel(
         'train_patterns': list(train_patterns),
         'eval_patterns': list(eval_patterns),
         'teacher_checkpoint': teacher_ckpt,
+        'window_buckets': list(window_buckets or ()),
     }
 
     def run() -> Dict:
@@ -726,6 +773,7 @@ def run_flywheel(
         'bf16_gate_threshold': int(bf16_gate_threshold),
         'eval_patterns': list(eval_patterns),
         'checkpoint': student_ckpt,
+        'baseline_checkpoint': baseline_checkpoint or '',
     }
 
     def run() -> Dict:
@@ -739,6 +787,10 @@ def run_flywheel(
                        list(eval_patterns),
                        threshold=bf16_gate_threshold),
       ]
+      if baseline_checkpoint:
+        gates.append(long_insert_identity_record(
+            student_params, variables, baseline_checkpoint,
+            list(eval_patterns), gates_dir))
       return {'gates': gates}
 
     return Stage('gates', inputs, run)

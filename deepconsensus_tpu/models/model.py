@@ -24,6 +24,8 @@ import ml_collections
 import numpy as np
 
 from deepconsensus_tpu import constants
+from deepconsensus_tpu.models import config as config_lib
+from deepconsensus_tpu.parallel import ring_attention as ring_lib
 from deepconsensus_tpu.preprocess.pileup import row_indices
 
 
@@ -125,7 +127,8 @@ class BandedSelfAttention(nn.Module):
         kernel_init=nn.initializers.glorot_uniform(),
         name=name,
     )
-    query = dense('query')(x) * (head_dim**-0.5)
+    query_raw = dense('query')(x)
+    query = query_raw * (head_dim**-0.5)
     key = dense('key')(x)
     value = dense('value')(x)
 
@@ -169,6 +172,28 @@ class BandedSelfAttention(nn.Module):
       )(out)
 
     use_dropout = not deterministic and self.dropout_rate > 0.0
+    if (x.shape[1] >= config_lib.RING_ATTENTION_MIN_LEN
+        and not use_dropout):
+      # Long-insert windows: past the crossover the [B, N, L, L]
+      # logits/weights tensors dominate memory (at L=500 the fused
+      # kernel's whole-L VMEM tiling no longer fits either), so
+      # attention runs as the blockwise ring scan — exact, banded, and
+      # differentiable, with K/V streamed through the online softmax.
+      # The scan never materializes attention weights, so weight
+      # dropout is unavailable here; long-insert configs set
+      # attention_dropout=0 (training with dropout falls through to
+      # the paths below).
+      out = ring_lib.ring_attention_blockwise(
+          query_raw, key, value, self.attn_win_size or None
+      )
+      return nn.DenseGeneral(
+          features=self.hidden_size,
+          axis=(-2, -1),
+          use_bias=False,
+          dtype=self.dtype,
+          kernel_init=nn.initializers.glorot_uniform(),
+          name='output_transform',
+      )(out)
     use_pallas = self.use_pallas
     long_window = False
     if use_pallas:

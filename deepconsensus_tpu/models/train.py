@@ -40,6 +40,7 @@ from deepconsensus_tpu.models import metrics as metrics_lib
 from deepconsensus_tpu.models import model as model_lib
 from deepconsensus_tpu.parallel import mesh as mesh_lib
 from deepconsensus_tpu.parallel import partition_rules
+from deepconsensus_tpu.parallel import ring_attention as ring_lib
 from deepconsensus_tpu.preprocess.pileup import row_indices
 
 
@@ -170,21 +171,30 @@ class Trainer:
   pod_slices_batches: bool = True
 
   def __post_init__(self):
-    # Training fixes ONE window shape: the jitted step compiles for a
-    # single [B, R, L, 1] geometry, while window_buckets is the PR-12
-    # ragged-dispatch inference lever. Reject at construction with the
-    # remedy instead of failing later with an opaque XLA shape error.
-    buckets = config_lib.resolve_window_buckets(self.params)
-    if len(buckets) > 1:
-      raise faults_lib.BucketedTrainingError(
-          f'training fixes one window shape but window_buckets='
-          f'{tuple(buckets)} requests variable-length buckets. Buckets '
-          'are an inference lever (`dctpu run/serve --window_buckets`); '
-          'drop window_buckets from the training config and train at '
-          f'max_length={int(self.params.max_length)}. Bucketed/long-'
-          'insert TRAINING is tracked as ROADMAP item 1 (long-insert '
-          'workloads on top of bucketed windows).'
+    # Bucketed training compiles one pjit step per bucket width over a
+    # single param tree, so the bucket SET must be valid at
+    # construction (strictly ascending, smallest == max_length — the
+    # normalizer's contract) and the model family must be
+    # length-agnostic: the FC head sizes its output Dense by
+    # max_length, so one param tree cannot serve two widths there.
+    try:
+      buckets = config_lib.resolve_window_buckets(self.params)
+    except ValueError as e:
+      raise faults_lib.WindowBucketError(str(e)) from e
+    if (len(buckets) > 1
+        and not str(self.params.model_name).startswith('transformer')):
+      raise faults_lib.WindowBucketError(
+          f'window_buckets={tuple(buckets)} needs a length-agnostic '
+          f'model, but model_name={self.params.model_name!r} has '
+          'window-width-dependent parameter shapes (the FC head is '
+          'sized by max_length); use a transformer config for bucketed '
+          'training'
       )
+    self.window_buckets = buckets
+    # Distinct train-step traces (== compiled batch geometries). One
+    # per bucket width on a clean bucketed run; mesh degradation
+    # legitimately re-traces.
+    self.n_train_forward_shapes = 0
     os.makedirs(self.out_dir, exist_ok=True)
     enable_compilation_cache()
     self.model = model_lib.get_model(self.params)
@@ -284,6 +294,12 @@ class Trainer:
     loss_obj = self.loss_fn
 
     def step(state: TrainState, batch: Dict[str, jnp.ndarray]):
+      # Python body == one pjit trace. jit caches one executable per
+      # batch geometry, so over a bucketed stream this counts exactly
+      # n_buckets traces (surfaced as n_train_forward_shapes; the
+      # compile-once tests pin it — a value above the bucket count
+      # means mid-run recompiles).
+      self.n_train_forward_shapes += 1
       rng = jax.random.fold_in(state.dropout_rng, state.step)
       mutable = list(state.model_state.keys())
 
@@ -1258,6 +1274,10 @@ def run_training(
   obs_lib.trace.configure_from_env(tier='train')
   obs_lib.trace.set_trace_id(obs_lib.trace.mint_trace_id())
   obs_lib.profiler.install_sigusr2(os.path.join(out_dir, 'profile'))
+  # Snapshot the module-global blockwise-attention trace count so the
+  # end-of-run delta attributes ring routing to THIS run (tests train
+  # several models per process).
+  ring_traces_start = ring_lib.n_blockwise_traces
 
   profile_dir = params.get('profile_dir', None)
   if profile_dir:
@@ -1755,6 +1775,21 @@ def run_training(
       pod.close()
     if stream_ds is not None:
       fault_counters.update(stream_ds.counters)
+    if train_ds is not None:
+      fault_counters.update(train_ds.counters)
+    # Bucketed-training observability: distinct compiled step
+    # geometries (clean run: == n buckets), ring-attention routing for
+    # long-insert widths, and the padding waste of bucket triage.
+    fault_counters['n_train_forward_shapes'] = float(
+        trainer.n_train_forward_shapes)
+    ring_traces = ring_lib.n_blockwise_traces - ring_traces_start
+    if ring_traces:
+      fault_counters['n_ring_attention_traces'] = float(ring_traces)
+    total_pos = float(fault_counters.get('n_train_window_positions', 0))
+    if total_pos:
+      fault_counters['train_padding_fraction'] = (
+          float(fault_counters.get('n_train_padded_positions', 0))
+          / total_pos)
     if prefetcher is not None:
       # Transfer-overlap observability: a clean N-step run reports
       # train_transfer_overlap_fraction == (N-1)/N (every launch after

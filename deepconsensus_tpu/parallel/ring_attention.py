@@ -28,6 +28,13 @@ Array = jnp.ndarray
 
 _NEG_INF = -1e30
 
+# Count of ring_attention_blockwise *traces* (the Python body runs only
+# when jit traces a new shape). Training surfaces this as
+# n_ring_attention_traces in the faults sidecar, and the long-insert
+# tests use it to prove the L=500 forward really routed through the
+# blockwise scan rather than the fused/XLA logits path.
+n_blockwise_traces = 0
+
 
 def _mark_varying(x: Array, axis_name: str) -> Array:
   """Marks x device-varying over axis_name so the scan carry types line
@@ -122,6 +129,75 @@ def ring_attention(
 
   (k, v, m, l_sum, o), _ = jax.lax.scan(
       step, (k, v, m, l_sum, o), jnp.arange(axis_size)
+  )
+  denom = jnp.transpose(l_sum, (0, 2, 1))[..., None]
+  return o / jnp.maximum(denom, 1e-30)
+
+
+def ring_attention_blockwise(
+    q: Array,
+    k: Array,
+    v: Array,
+    attn_win_size: Optional[int] = None,
+    block_size: int = 128,
+) -> Array:
+  """Single-device ring attention: K/V stream through the online
+  softmax in blocks instead of rotating over a mesh axis.
+
+  The degenerate ring (axis_size = ceil(L / block_size), identity
+  permutation) keeps queries resident and accumulates flash-style
+  partial softmaxes per K/V block, so the [B, H, L, L] logits tensor is
+  never materialized — peak activation memory is O(L * block_size) per
+  head. This is the training forward for windows past the fused
+  kernel's VMEM limit (the L=500 long-insert bucket): a plain lax.scan
+  of differentiable ops, so gradients flow through it with no custom
+  VJP.
+
+  Fully-banded-out (q, k-block) rows self-heal exactly as in
+  ring_attention: their running max stays _NEG_INF, and the first real
+  block rescales the junk accumulator by exp(_NEG_INF - m_real) == 0.
+
+  q, k, v: [B, L, H, D] -> [B, L, H, D]. Like ring_attention, scores
+  are scaled by D**-0.5 internally — pass the unscaled query.
+  """
+  global n_blockwise_traces
+  n_blockwise_traces += 1
+  b, l, h, d = q.shape
+  block = int(min(block_size, l))
+  n_blocks = -(-l // block)
+  pad = n_blocks * block - l
+  k_p = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+  v_p = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+  k_blocks = jnp.moveaxis(k_p.reshape(b, n_blocks, block, h, d), 1, 0)
+  v_blocks = jnp.moveaxis(v_p.reshape(b, n_blocks, block, h, d), 1, 0)
+  k_offsets = jnp.arange(n_blocks) * block
+
+  m0 = jnp.full((b, h, l), _NEG_INF, q.dtype)
+  l0 = jnp.zeros((b, h, l), q.dtype)
+  o0 = jnp.zeros((b, l, h, d), q.dtype)
+
+  def step(carry, xs):
+    m, l_sum, o = carry
+    k_cur, v_cur, k_off = xs
+    s = _block_attention(q, k_cur, v_cur, jnp.asarray(0), k_off,
+                         attn_win_size)
+    # Padded key slots (global index >= L) are masked out regardless of
+    # the band so the pad never enters any softmax.
+    valid = (k_off + jnp.arange(block)) < l
+    s = jnp.where(valid[None, None, None, :], s, _NEG_INF)
+    m_block = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m, m_block)
+    scale = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l_sum * scale + jnp.sum(p, axis=-1)
+    o_new = (
+        o * jnp.transpose(scale, (0, 2, 1))[..., None]
+        + jnp.einsum('bhqk,bkhd->bqhd', p, v_cur)
+    )
+    return (m_new, l_new, o_new), None
+
+  (_, l_sum, o), _ = jax.lax.scan(
+      step, (m0, l0, o0), (k_blocks, v_blocks, k_offsets)
   )
   denom = jnp.transpose(l_sum, (0, 2, 1))[..., None]
   return o / jnp.maximum(denom, 1e-30)

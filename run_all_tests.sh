@@ -57,6 +57,14 @@
 #                                  # mixed-stream byte identity vs the
 #                                  # per-bucket fleet at dp {1,8}, and
 #                                  # the trace-span residency gates
+#   ./run_all_tests.sh longwin     # bucketed multi-width training and
+#                                  # the L=500 long-insert path only:
+#                                  # per-bucket compile-once gates,
+#                                  # dp8-vs-dp1 two-bucket loss parity,
+#                                  # ring-attention fwd+grad parity at
+#                                  # L=500, starvation/overflow stream
+#                                  # drills, and the slow L=500 train +
+#                                  # bucketed-flywheel e2e drills
 #
 # Two-tier structure: the `slow` marker covers the heavy interpret-mode
 # Pallas golden sweeps (wavefront train/VJP/unroll, banded-attention
@@ -134,6 +142,11 @@ fi
 if [[ "${1:-}" == "ragged" ]]; then
   exec python -m pytest \
     tests/test_ragged_kernel.py tests/test_ragged_engine.py -q
+fi
+
+if [[ "${1:-}" == "longwin" ]]; then
+  exec python -m pytest \
+    tests/test_longwin_training.py tests/test_ring_attention.py -q
 fi
 
 # Static analysis first: dclint runs in under a second and fails fast

@@ -14,6 +14,17 @@ equal curve). jax pins the device count at backend init, so a dp sweep
 runs this script once per dp in fresh subprocesses (bench.py's
 train_dp_scaling stage does exactly that with --force_host_devices 8).
 
+--window_buckets W1,W2,... (dp mode only) makes the run bucketed: the
+synthetic stream mixes windows at every bucket width, the model is the
+transformer (the fc head is width-locked), and the row additionally
+reports n_train_forward_shapes (the compile-once-per-bucket gate:
+must equal the bucket count), per-bucket batch counters, the measured
+train_padding_fraction, and padding_fraction_padmax — the waste the
+same stream would pay under the old single-shape pad-to-max policy.
+The padding delta is stream arithmetic (backend-independent); the
+windows/s A/B against pad-to-max defers to live chips
+(scripts/measure_r4.sh train_bucketed).
+
 Prints one JSON line per run so a tunnel hang keeps completed rows.
 """
 import argparse
@@ -40,34 +51,59 @@ def _run_dp_mode(args):
   from deepconsensus_tpu.models import train as train_lib
   from deepconsensus_tpu.parallel import mesh as mesh_lib
 
+  buckets = tuple(args.window_buckets or ())
   work = tempfile.mkdtemp(prefix=f'dc_bench_train_dp{args.dp}_')
   row = {'dp': args.dp, 'global_batch': args.global_batch,
          'steps': args.train_steps,
          'n_devices_visible': jax.device_count()}
+  if buckets:
+    row['window_buckets'] = list(buckets)
   try:
-    shard_dir = os.path.join(work, 'shards')
-    n_examples = args.global_batch * args.train_steps
-    inject_faults.write_synthetic_tfrecords(
-        shard_dir, n_shards=2, n_examples=n_examples,
-        max_passes=5, max_length=20)
-    params = config_lib.get_config('fc+test')
+    train_patterns = []
+    if buckets:
+      # One shard set per bucket width so the stream genuinely mixes
+      # widths; steps split evenly across buckets.
+      n_per_width = args.global_batch * max(
+          1, args.train_steps // len(buckets))
+      for width in buckets:
+        shard_dir = os.path.join(work, f'shards_w{width}')
+        inject_faults.write_synthetic_tfrecords(
+            shard_dir, n_shards=1, n_examples=n_per_width,
+            max_passes=5, max_length=width)
+        train_patterns.append(shard_dir + '/*')
+      n_examples = n_per_width * len(buckets)
+      # The fc head is width-locked; bucketed runs need the
+      # length-agnostic transformer family.
+      params = config_lib.get_config('transformer_learn_values+test')
+    else:
+      shard_dir = os.path.join(work, 'shards')
+      n_examples = args.global_batch * args.train_steps
+      inject_faults.write_synthetic_tfrecords(
+          shard_dir, n_shards=2, n_examples=n_examples,
+          max_passes=5, max_length=20)
+      train_patterns.append(shard_dir + '/*')
+      params = config_lib.get_config('fc+test')
     with params.unlocked():
       params.max_passes = 5
-      params.max_length = 20
+      params.max_length = buckets[0] if buckets else 20
     config_lib.finalize_params(params)
     with params.unlocked():
       params.dtype = 'float32'
       params.batch_size = args.global_batch
       params.log_every_n_steps = 1
       params.seed = 7
+      if buckets:
+        params.window_buckets = buckets
+        params.num_hidden_layers = 1
+        params.filter_size = 32
     out_dir = os.path.join(work, 'out')
     mesh = mesh_lib.make_mesh(
         dp=args.dp, tp=1, devices=jax.devices()[:args.dp])
     t0 = time.perf_counter()
     train_lib.run_training(
         params=params, out_dir=out_dir,
-        train_patterns=[shard_dir + '/*'],
-        eval_patterns=[shard_dir + '/*'],
+        train_patterns=train_patterns,
+        eval_patterns=train_patterns[:1],
         num_epochs=1, mesh=mesh, eval_every=1_000_000)
     row['wall_s'] = round(time.perf_counter() - t0, 2)
     with open(os.path.join(out_dir, 'metrics.jsonl')) as f:
@@ -88,6 +124,24 @@ def _run_dp_mode(args):
     row['n_batches_prefetched'] = faults.get('n_batches_prefetched')
     row['train_transfer_overlap_fraction'] = faults.get(
         'train_transfer_overlap_fraction')
+    if buckets:
+      # Compile-once gate + the padding-waste A/B: measured fraction
+      # under bucketing vs the arithmetic waste of padding the same
+      # stream to the widest bucket (the old single-shape policy).
+      row['n_train_forward_shapes'] = faults.get('n_train_forward_shapes')
+      for width in buckets:
+        row[f'n_train_batches_by_bucket_{width}'] = faults.get(
+            f'n_train_batches_by_bucket_{width}')
+      row['train_padding_fraction'] = faults.get('train_padding_fraction')
+      wmax = max(buckets)
+      padmax_pos = sum(
+          (faults.get(f'n_train_batches_by_bucket_{w}', 0) or 0)
+          * args.global_batch * wmax for w in buckets)
+      real_pos = faults.get('n_train_window_positions', 0.0)
+      padded = faults.get('n_train_padded_positions', 0.0)
+      if padmax_pos:
+        row['padding_fraction_padmax'] = round(
+            1.0 - (real_pos - padded) / padmax_pos, 4)
   except Exception as e:  # keep the row; a failed point is a result
     row['error'] = repr(e)[:200]
   finally:
@@ -111,6 +165,12 @@ def main():
                   help='dp mode: FIXED global batch across the sweep.')
   ap.add_argument('--train_steps', type=int, default=8,
                   help='dp mode: training steps per point.')
+  ap.add_argument('--window_buckets', type=lambda s: tuple(
+      int(w) for w in s.split(',')), default=None,
+                  help='dp mode: comma-separated ascending bucket '
+                  'widths (e.g. 100,200). Mixes one synthetic shard '
+                  'set per width and reports the per-bucket compile '
+                  'and padding counters.')
   ap.add_argument('--force_host_devices', type=int, default=None,
                   help='Fake N CPU devices (sets XLA_FLAGS; must be '
                   'set before jax initializes, i.e. via this flag, '

@@ -164,6 +164,27 @@ run_stage train_dp2 900 \
 run_stage train_dp4 900 \
   python "$REPO/scripts/bench_train_scaling.py" --dp 4 --global_batch 1024 \
   --train_steps 6
+# Bucketed multi-width training (round-20 beat-or-retire): a mixed
+# L={100,200} stream, per-bucket width-pure batches with one compiled
+# step per bucket. Reads: n_train_forward_shapes (must equal 2 — zero
+# mid-run retraces), train_padding_fraction vs padding_fraction_padmax
+# (the same stream under the old pad-to-widest policy; the examples/s
+# win should track the padded positions removed), and examples/s
+# against the train_scaling b1024 anchor. Decision rule in
+# docs/performance.md: bucketing stays the mixed-width training
+# default only if examples/s beats pad-to-max on this stage.
+run_stage train_bucketed 900 \
+  python "$REPO/scripts/bench_train_scaling.py" --dp 4 --global_batch 1024 \
+  --train_steps 6 --window_buckets 100,200
+# Long-insert training (round-20): L=500 windows route the attention
+# forward+backward through the blockwise ring scan
+# (parallel/ring_attention.py; fused Pallas is L<=128-only, plain XLA
+# attention materializes the full 500x500 score matrix per head).
+# Reads: examples/s and peak HBM headroom at batch 256; parity vs the
+# XLA path is locked at atol<=1e-4 in tests/test_longwin_training.py.
+run_stage train_L500 1200 \
+  python "$REPO/scripts/bench_train_scaling.py" --dp 4 --global_batch 256 \
+  --train_steps 6 --window_buckets 500
 run_stage train_stages_b1024 900 \
   python "$REPO/scripts/bench_train_stages.py" --batches 1024 --steps 6
 # Pallas wavefront unroll A/B under the persistent compile cache

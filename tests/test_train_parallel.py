@@ -351,17 +351,33 @@ def test_device_lost_without_degrade_fails_fast(shards, tmp_path,
 
 
 # ----------------------------------------------------------------------
-# Guard rails: bucketed training rejection + flywheel gate enforcement
+# Guard rails: bucket-set validation + flywheel gate enforcement
 
 
-def test_training_rejects_multi_bucket_windows(tmp_path):
+def test_invalid_bucket_sets_raise_typed(tmp_path):
+  """Genuinely invalid bucket sets stay a typed config-time fault.
+  Valid multi-bucket sets train (tests/test_longwin_training.py); what
+  must still be refused is a bucket list that cannot work: widths out
+  of order, or a model family whose parameter shapes depend on the
+  window width."""
+  # Non-ascending widths are operator error at config time.
+  params = tiny_params()
+  with params.unlocked():
+    params.window_buckets = (40, 20)
+  with pytest.raises(faults_lib.WindowBucketError):
+    train_lib.Trainer(params=params, out_dir=str(tmp_path / 'order'),
+                      mesh=None)
+  # The FC head sizes its output Dense by max_length: one param tree
+  # cannot serve two widths, so fc + multi-bucket is refused with the
+  # remedy (use a transformer config).
   params = tiny_params()
   with params.unlocked():
     params.window_buckets = (20, 40)
-  with pytest.raises(faults_lib.BucketedTrainingError) as ei:
-    train_lib.Trainer(params=params, out_dir=str(tmp_path), mesh=None)
+  with pytest.raises(faults_lib.WindowBucketError) as ei:
+    train_lib.Trainer(params=params, out_dir=str(tmp_path / 'fc'),
+                      mesh=None)
   msg = str(ei.value)
-  assert 'window_buckets' in msg and 'ROADMAP item 1' in msg
+  assert 'window_buckets' in msg and 'transformer' in msg
   # ValueError subclass: `dctpu train` maps it to exit code 2.
   assert isinstance(ei.value, ValueError)
 

@@ -52,7 +52,7 @@ FAULT_TYPES = frozenset({
     'RequestTooLargeError',
     'CrashLoopError',
     'NonFiniteTrainingError',
-    'BucketedTrainingError',
+    'WindowBucketError',
     'FlywheelGateError',
     'FlywheelStageError',
     'FlywheelResumeError',
@@ -212,6 +212,9 @@ GUARDED_BY_SCOPE = (
     # TrainBatchPrefetcher's producer thread shares counters and the
     # mesh-generation with the training loop.
     'deepconsensus_tpu/models/train.py',
+    # StreamingDataset's shard-reader thread shares the parse counters
+    # and the per-bucket accumulators with the consuming train loop.
+    'deepconsensus_tpu/models/data.py',
     # The flywheel orchestration dispatch (train/distill drive their
     # own threads through run_training's machinery).
     'deepconsensus_tpu/cli.py',
@@ -260,7 +263,7 @@ REGISTRY_WRITES_EXEMPT = ('deepconsensus_tpu/obs/metrics.py',)
 # shape-literals
 # ---------------------------------------------------------------------------
 
-SHAPE_LITERAL_VALUES = frozenset({100, 128, 200})
+SHAPE_LITERAL_VALUES = frozenset({100, 128, 200, 256, 500})
 
 # The one place window-shape defaults may live.
 SHAPE_LITERALS_EXEMPT = ('deepconsensus_tpu/models/config.py',)
